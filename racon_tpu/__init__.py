@@ -1,0 +1,28 @@
+"""racon-tpu: a TPU-native consensus / polishing framework.
+
+A from-scratch re-design of the capabilities of racon (Vaser et al., Genome
+Research 2017; reference implementation: open-estuary/racon, C++/CPU) for
+TPU hardware using JAX/XLA/Pallas.
+
+Architecture (vs. reference layers, see SURVEY.md):
+
+  reference (C++/CPU, thread pool)        racon-tpu (JAX/TPU)
+  --------------------------------        --------------------------------
+  bioparser (streaming format IO)     ->  racon_tpu.io (Python + C++ native)
+  Sequence/Overlap/Window domain      ->  racon_tpu.models.{sequence,overlap,window}
+  edlib NW alignment (per overlap)    ->  racon_tpu.native banded-NW (C++),
+                                          racon_tpu.ops.nw batched TPU kernel
+  spoa POA engine (per window,        ->  racon_tpu.ops.poa_jax: batched POA,
+    per-thread engines)                   vmapped over windows, sharded over
+                                          chips via racon_tpu.parallel
+  thread_pool task parallelism        ->  batch parallelism: windows are the
+                                          batch dim; chips via shard_map Mesh;
+                                          hosts via target shards (wrapper)
+  Polisher orchestration              ->  racon_tpu.models.polisher
+  CLI (racon)                         ->  racon_tpu.cli (racon_tpu -m / console)
+"""
+
+__version__ = "0.1.0"
+
+from racon_tpu.models.sequence import Sequence  # noqa: F401
+from racon_tpu.models.overlap import Overlap  # noqa: F401

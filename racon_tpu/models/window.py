@@ -1,0 +1,180 @@
+"""Window: the unit of consensus — a backbone slice plus read layers.
+
+Re-design of the reference's Window (src/window.{hpp,cpp}). The reference
+holds raw (char*, len) pointers into Sequence storage and runs one SPOA
+graph per window on a CPU thread (src/window.hpp:61-67, window.cpp:61-137).
+Here a Window is a host-side descriptor holding zero-copy ``memoryview``
+slices; consensus is computed for *batches* of windows at once by the JAX
+engine (racon_tpu.ops.poa_jax), with windows as the batch dimension.
+
+Parity points:
+- createWindow validates a non-empty backbone with equal-length quality
+  (src/window.cpp:19-23).
+- add_layer validates quality length and begin/end positions
+  (src/window.cpp:42-59).
+- Consensus of a window with fewer than 3 total sequences (backbone + 2
+  layers) is the backbone itself, marked unpolished (src/window.cpp:63-66).
+- Layers are processed sorted by window-relative begin (src/window.cpp:74-80).
+- kTGS windows trim consensus ends with coverage < (n_seqs - 1) / 2
+  (src/window.cpp:113-134); fully-trimmed windows warn about a chimeric
+  contig and keep the untrimmed consensus.
+"""
+
+from __future__ import annotations
+
+import enum
+import sys
+from typing import List, Optional
+
+import numpy as np
+
+from racon_tpu.models.overlap import PolisherError
+from racon_tpu.ops.encode import encode_bases
+
+
+class WindowType(enum.Enum):
+    NGS = 0  # mean read length <= 1000 (src/polisher.cpp:246-247)
+    TGS = 1
+
+
+class Window:
+    __slots__ = (
+        "id", "rank", "type",
+        "backbone", "backbone_quality",
+        "layer_data", "layer_quality", "layer_begin", "layer_end",
+        "consensus", "polished",
+    )
+
+    def __init__(self, id_: int, rank: int, type_: WindowType,
+                 backbone, backbone_quality) -> None:
+        if len(backbone) == 0 or (backbone_quality is not None and
+                                  len(backbone) != len(backbone_quality)):
+            raise PolisherError(
+                "[racon_tpu::create_window] error: "
+                "empty backbone sequence/unequal quality length!")
+        self.id = id_
+        self.rank = rank
+        self.type = type_
+        self.backbone = backbone
+        self.backbone_quality = backbone_quality
+        self.layer_data: List = []
+        self.layer_quality: List[Optional[object]] = []
+        self.layer_begin: List[int] = []
+        self.layer_end: List[int] = []
+        self.consensus: Optional[bytes] = None
+        self.polished = False
+
+    def __len__(self) -> int:
+        return len(self.backbone)
+
+    @property
+    def n_layers(self) -> int:
+        return len(self.layer_data)
+
+    def add_layer(self, data, quality, begin: int, end: int) -> None:
+        """Append a read segment layer (src/window.cpp:42-59).
+
+        ``begin``/``end`` are window-relative target positions; ``end`` is
+        the inclusive last matched backbone position (the reference passes
+        last_match.t - window_start - 1, src/polisher.cpp:439-442).
+        """
+        if quality is not None and len(data) != len(quality):
+            raise PolisherError(
+                "[racon_tpu::Window::add_layer] error: unequal quality size!")
+        if begin >= end or begin > len(self.backbone) or end > len(self.backbone):
+            raise PolisherError(
+                "[racon_tpu::Window::add_layer] error: "
+                "layer begin and end positions are invalid!")
+        self.layer_data.append(data)
+        self.layer_quality.append(quality)
+        self.layer_begin.append(begin)
+        self.layer_end.append(end)
+
+    def set_backbone_consensus(self) -> None:
+        """Windows that cannot be polished keep their backbone
+        (src/window.cpp:63-66)."""
+        self.consensus = bytes(self.backbone)
+        self.polished = False
+
+    def apply_consensus(self, consensus: bytes, coverage: np.ndarray,
+                        log=sys.stderr) -> None:
+        """Install an engine-produced consensus, applying the kTGS coverage
+        trim (src/window.cpp:113-134)."""
+        if self.type == WindowType.TGS:
+            average_coverage = (self.n_layers + 1 - 1) // 2  # (n_seqs-1)/2
+            keep = np.flatnonzero(coverage[:len(consensus)] >= average_coverage)
+            if len(keep) == 0 or keep[0] >= keep[-1]:
+                print(
+                    f"[racon_tpu::Window::generate_consensus] warning: contig "
+                    f"{self.id} might be chimeric in window {self.rank}!",
+                    file=log)
+            else:
+                consensus = consensus[keep[0]:keep[-1] + 1]
+        self.consensus = consensus
+        self.polished = True
+
+
+def sorted_layer_order(window: Window) -> np.ndarray:
+    """Layer processing order: ascending window-relative begin
+    (src/window.cpp:74-80). Stable to keep input order among ties."""
+    return np.argsort(np.asarray(window.layer_begin, dtype=np.int64),
+                      kind="stable")
+
+
+class WindowBatch:
+    """Padded device-ready arrays for a batch of same-bucket windows.
+
+    Layout (B = windows, C = max layers, L = max sequence length):
+      backbone   uint8[B, L]   base codes (0..4), zero-padded
+      backbone_w uint8[B, L]   per-base weights (phred-33, or 0 dummy —
+                               the reference feeds '!' dummy quality for
+                               targets without quality, src/polisher.cpp:141,383)
+      backbone_len int32[B]
+      layers     uint8[B, C, L]
+      layer_w    uint8[B, C, L] (phred-33 with quality, 1 without —
+                               SPOA default weight)
+      layer_len  int32[B, C]
+      layer_begin/end int32[B, C]  window-relative positions
+      n_layers   int32[B]
+    """
+
+    __slots__ = ("windows", "backbone", "backbone_w", "backbone_len",
+                 "layers", "layer_w", "layer_len", "layer_begin", "layer_end",
+                 "n_layers")
+
+    def __init__(self, windows: List[Window], max_layers: int, max_len: int):
+        B, C, L = len(windows), max_layers, max_len
+        self.windows = windows
+        self.backbone = np.zeros((B, L), dtype=np.uint8)
+        self.backbone_w = np.zeros((B, L), dtype=np.uint8)
+        self.backbone_len = np.zeros(B, dtype=np.int32)
+        self.layers = np.zeros((B, C, L), dtype=np.uint8)
+        self.layer_w = np.zeros((B, C, L), dtype=np.uint8)
+        self.layer_len = np.zeros((B, C), dtype=np.int32)
+        self.layer_begin = np.zeros((B, C), dtype=np.int32)
+        self.layer_end = np.zeros((B, C), dtype=np.int32)
+        self.n_layers = np.zeros(B, dtype=np.int32)
+
+        for b, w in enumerate(windows):
+            lb = len(w.backbone)
+            self.backbone_len[b] = lb
+            self.backbone[b, :lb] = encode_bases(bytes(w.backbone))
+            if w.backbone_quality is not None:
+                q = np.frombuffer(bytes(w.backbone_quality), dtype=np.uint8)
+                self.backbone_w[b, :lb] = q - 33
+            order = sorted_layer_order(w)
+            n = min(len(order), C)
+            self.n_layers[b] = n
+            for c, li in enumerate(order[:n]):
+                data = bytes(w.layer_data[li])
+                ll = min(len(data), L)
+                self.layer_len[b, c] = ll
+                self.layers[b, c, :ll] = encode_bases(data[:ll])
+                qual = w.layer_quality[li]
+                if qual is None:
+                    self.layer_w[b, c, :ll] = 1
+                else:
+                    q = np.frombuffer(bytes(qual), dtype=np.uint8)[:ll]
+                    self.layer_w[b, c, :ll] = q - 33
+                self.layer_begin[b, c] = w.layer_begin[li]
+                self.layer_end[b, c] = w.layer_end[li]

@@ -1,0 +1,29 @@
+"""Test configuration.
+
+Force the CPU backend with a virtual 8-device mesh so sharding/pjit tests
+run without TPU hardware, as the build brief prescribes. Must run before
+jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+REFERENCE_DATA = "/root/reference/test/data"
+
+
+def reference_data_path(name: str) -> str:
+    return os.path.join(REFERENCE_DATA, name)
+
+
+@pytest.fixture(scope="session")
+def ref_data():
+    if not os.path.isdir(REFERENCE_DATA):
+        pytest.skip("reference dataset not available")
+    return reference_data_path

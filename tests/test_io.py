@@ -1,0 +1,161 @@
+"""IO parser tests: synthetic round-trips plus reference-dataset counts.
+
+Golden counts are derived from the reference's bundled lambda-phage dataset
+(see SURVEY.md section 4: 236 reads / 1,674,628 bp, 181 read-to-contig PAF
+records, 8016 all-vs-all PAF records, one 47,564 bp layout contig).
+"""
+
+import gzip
+
+import pytest
+
+from racon_tpu.io.parsers import (
+    FastaParser, FastqParser, MhapParser, PafParser, SamParser,
+    create_overlap_parser, create_sequence_parser, ParseError,
+)
+
+
+def test_fasta_roundtrip(tmp_path):
+    p = tmp_path / "x.fasta"
+    p.write_text(">s1 description here\nACGT\nacgt\n>s2\nTTTT\n")
+    seqs = FastaParser(str(p)).parse_all()
+    assert [s.name for s in seqs] == ["s1", "s2"]
+    assert seqs[0].data == b"ACGTACGT"  # multi-line + uppercased
+    assert seqs[1].data == b"TTTT"
+    assert seqs[0].quality is None
+
+
+def test_fasta_gzip(tmp_path):
+    p = tmp_path / "x.fasta.gz"
+    with gzip.open(p, "wt") as f:
+        f.write(">a\nACGT\n")
+    seqs = FastaParser(str(p)).parse_all()
+    assert seqs[0].data == b"ACGT"
+
+
+def test_fastq_quality_and_all_bang(tmp_path):
+    p = tmp_path / "x.fastq"
+    p.write_text("@r1\nACGT\n+\nII!I\n@r2\nACGT\n+\n!!!!\n")
+    seqs = FastqParser(str(p)).parse_all()
+    assert seqs[0].quality == b"II!I"
+    # all-'!' quality is dropped (reference src/sequence.cpp:34-42)
+    assert seqs[1].quality is None
+
+
+def test_chunked_parse(tmp_path):
+    p = tmp_path / "x.fasta"
+    p.write_text("".join(f">s{i}\n{'ACGT' * 100}\n" for i in range(10)))
+    parser = FastaParser(str(p))
+    total = []
+    more = True
+    rounds = 0
+    while more:
+        recs, more = parser.parse(max_bytes=1000)
+        total.extend(recs)
+        rounds += 1
+    assert len(total) == 10
+    assert rounds > 1  # actually streamed
+
+
+def test_paf_parse(tmp_path):
+    p = tmp_path / "x.paf"
+    p.write_text("q1\t100\t5\t95\t-\tt1\t200\t10\t110\t80\t90\t60\n")
+    o = PafParser(str(p)).parse_all()[0]
+    assert o.q_name == "q1" and o.t_name == "t1"
+    assert o.strand is True
+    assert (o.q_begin, o.q_end, o.q_length) == (5, 95, 100)
+    assert (o.t_begin, o.t_end, o.t_length) == (10, 110, 200)
+    assert o.length == 100  # max span
+    assert abs(o.error - (1 - 90 / 100)) < 1e-9
+
+
+def test_mhap_parse(tmp_path):
+    p = tmp_path / "x.mhap"
+    p.write_text("1 2 0.05 42 0 5 95 100 1 10 110 200\n")
+    o = MhapParser(str(p)).parse_all()[0]
+    assert o.q_id == 0 and o.t_id == 1  # 1-based -> 0-based
+    assert o.strand is True  # 0 XOR 1
+
+
+def test_sam_parse(tmp_path):
+    p = tmp_path / "x.sam"
+    p.write_text(
+        "@HD\tVN:1.6\n"
+        "r1\t0\tctg\t11\t60\t5S10M2I3D5M\t*\t0\t0\tAAAAAAAAAAAAAAAAAAAAAA\t*\n"
+        "r2\t4\t*\t0\t0\t*\t*\t0\t0\tAAAA\t*\n")
+    ovls = SamParser(str(p)).parse_all()
+    o = ovls[0]
+    assert o.t_begin == 10  # 1-based POS -> 0-based
+    assert o.q_begin == 5  # leading clip
+    assert o.q_end == 5 + 10 + 2 + 5
+    assert o.q_length == 5 + 17
+    assert o.t_end == 10 + 10 + 3 + 5
+    assert ovls[1].is_valid is False  # unmapped flag 0x4
+
+
+def test_sam_reverse_strand_flips_query_coords(tmp_path):
+    p = tmp_path / "x.sam"
+    p.write_text("r1\t16\tctg\t1\t60\t5S10M\t*\t0\t0\t*\t*\n")
+    o = SamParser(str(p)).parse_all()[0]
+    assert o.strand is True
+    # forward coords were (5, 15) in a 15-long query
+    assert (o.q_begin, o.q_end) == (0, 10)
+
+
+def test_extension_dispatch_errors(tmp_path):
+    bad = tmp_path / "x.txt"
+    bad.write_text("")
+    with pytest.raises(ParseError, match="unsupported format"):
+        create_sequence_parser(str(bad))
+    with pytest.raises(ParseError, match="unsupported format"):
+        create_overlap_parser(str(bad))
+
+
+# ------------------------- reference dataset golden counts -------------------
+
+
+def test_reference_reads_counts(ref_data):
+    seqs = FastaParser(ref_data("sample_reads.fasta.gz")).parse_all()
+    assert len(seqs) == 236
+    assert sum(len(s) for s in seqs) == 1674628
+
+
+def test_reference_fastq_matches_fasta(ref_data):
+    fa = FastaParser(ref_data("sample_reads.fasta.gz")).parse_all()
+    fq = FastqParser(ref_data("sample_reads.fastq.gz")).parse_all()
+    assert len(fq) == len(fa)
+    assert all(a.data == b.data for a, b in zip(fa, fq))
+    assert all(b.quality is not None and len(b.quality) == len(b.data)
+               for b in fq)
+
+
+def test_reference_layout_contig(ref_data):
+    seqs = FastaParser(ref_data("sample_layout.fasta.gz")).parse_all()
+    assert len(seqs) == 1
+    assert len(seqs[0]) == 47564
+
+
+def test_reference_overlap_counts(ref_data):
+    paf = PafParser(ref_data("sample_overlaps.paf.gz")).parse_all()
+    assert len(paf) == 181
+    ava = PafParser(ref_data("sample_ava_overlaps.paf.gz")).parse_all()
+    assert len(ava) == 8016
+    sam = SamParser(ref_data("sample_overlaps.sam.gz")).parse_all()
+    assert len(sam) > 0
+
+
+def test_reference_mhap_equals_paf(ref_data):
+    """PAF and MHAP encode the same all-vs-all overlaps (the reference's
+    FragmentCorrection tests produce identical output from both,
+    test/racon_test.cpp:237-289)."""
+    paf = PafParser(ref_data("sample_ava_overlaps.paf.gz")).parse_all()
+    mhap = MhapParser(ref_data("sample_ava_overlaps.mhap.gz")).parse_all()
+    # the PAF variant carries one self-overlap per read which the MHAP file
+    # omits; both are dropped downstream by the q_id == t_id filter
+    # (src/polisher.cpp:259-262)
+    paf = [o for o in paf if o.q_name != o.t_name]
+    assert len(paf) == len(mhap) == 7780
+    for a, b in zip(paf, mhap):
+        assert (a.q_begin, a.q_end, a.q_length) == (b.q_begin, b.q_end, b.q_length)
+        assert (a.t_begin, a.t_end, a.t_length) == (b.t_begin, b.t_end, b.t_length)
+        assert a.strand == b.strand

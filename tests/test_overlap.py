@@ -1,0 +1,163 @@
+"""Overlap transmute + breaking-points tests.
+
+The vectorized CIGAR walk is differential-tested against a literal
+base-by-base walk implementing the reference semantics
+(src/overlap.cpp:216-281) on random CIGARs.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from racon_tpu.models.overlap import (
+    Overlap, PolisherError, breaking_points_from_cigar, decompose_cigar,
+)
+from racon_tpu.models.sequence import Sequence
+
+
+def slow_breaking_points(cigar, t_begin, t_end, q_start, window_length):
+    """Base-by-base walk, straight from the reference's loop."""
+    window_ends = []
+    i = 0
+    while i < t_end:
+        if i > t_begin:
+            window_ends.append(i - 1)
+        i += window_length
+    window_ends.append(t_end - 1)
+
+    lens, ops = decompose_cigar(cigar)
+    w = 0
+    found_first = False
+    first = last = (0, 0)
+    q_ptr = q_start - 1
+    t_ptr = t_begin - 1
+    out = []
+    for n, op in zip(lens, ops):
+        op = chr(op)
+        if op in "M=X":
+            for _ in range(n):
+                q_ptr += 1
+                t_ptr += 1
+                if not found_first:
+                    found_first = True
+                    first = (t_ptr, q_ptr)
+                last = (t_ptr + 1, q_ptr + 1)
+                if w < len(window_ends) and t_ptr == window_ends[w]:
+                    if found_first:
+                        out.append(first)
+                        out.append(last)
+                    found_first = False
+                    w += 1
+        elif op == "I":
+            q_ptr += n
+        elif op in "DN":
+            for _ in range(n):
+                t_ptr += 1
+                if w < len(window_ends) and t_ptr == window_ends[w]:
+                    if found_first:
+                        out.append(first)
+                        out.append(last)
+                    found_first = False
+                    w += 1
+    return np.asarray(out, dtype=np.int64).reshape(-1, 4)
+
+
+def random_cigar(rng, t_span):
+    """Random CIGAR whose target advance equals t_span."""
+    parts = []
+    t_left = t_span
+    while t_left > 0:
+        op = rng.choice("MMMMMIDD")
+        n = rng.randint(1, min(37, t_left if op != "I" else 37))
+        if op == "I":
+            parts.append(f"{n}I")
+        else:
+            n = min(n, t_left)
+            parts.append(f"{n}{op}")
+            t_left -= n
+    return "".join(parts).encode()
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_breaking_points_match_slow_walk(seed):
+    rng = random.Random(seed)
+    t_begin = rng.randint(0, 900)
+    t_span = rng.randint(1, 2500)
+    t_end = t_begin + t_span
+    q_start = rng.randint(0, 100)
+    W = rng.choice([100, 500, 333])
+    cigar = random_cigar(rng, t_span)
+    fast = breaking_points_from_cigar(cigar, t_begin, t_end, q_start, W)
+    slow = slow_breaking_points(cigar, t_begin, t_end, q_start, W)
+    np.testing.assert_array_equal(fast, slow)
+
+
+def test_breaking_points_simple():
+    # 10M over a window boundary at W=5, t_begin=2: windows [2..4], [5..9]
+    bp = breaking_points_from_cigar(b"10M", 2, 12, 0, 5)
+    # windows touched: t in [2,4] (k=0), [5,9] (k=1), [10,11] (k=2)
+    assert bp.shape == (3, 4)
+    np.testing.assert_array_equal(bp[0], [2, 0, 5, 3])
+    np.testing.assert_array_equal(bp[1], [5, 3, 10, 8])
+    np.testing.assert_array_equal(bp[2], [10, 8, 12, 10])
+
+
+def test_breaking_points_deletion_only_window():
+    # first window covered only by deletions -> no pair for it
+    bp = breaking_points_from_cigar(b"5D5M", 0, 10, 0, 5)
+    assert bp.shape == (1, 4)
+    np.testing.assert_array_equal(bp[0], [5, 0, 10, 5])
+
+
+def _seqs():
+    target = Sequence("ctg", b"ACGT" * 25)
+    read = Sequence("r1", b"ACGT" * 10)
+    return [target, read]
+
+
+def test_transmute_by_name():
+    seqs = _seqs()
+    name_to_id = {"ctgt": 0, "r1q": 1}
+    o = Overlap.from_paf("r1", 40, 0, 40, "+", "ctg", 100, 10, 50)
+    o.transmute(seqs, name_to_id, {})
+    assert o.is_transmuted and o.q_id == 1 and o.t_id == 0
+
+
+def test_transmute_unknown_name_invalidates():
+    o = Overlap.from_paf("zz", 40, 0, 40, "+", "ctg", 100, 10, 50)
+    o.transmute(_seqs(), {"ctgt": 0}, {})
+    assert not o.is_valid
+
+
+def test_transmute_length_mismatch_fatal():
+    o = Overlap.from_paf("r1", 39, 0, 39, "+", "ctg", 100, 10, 50)
+    with pytest.raises(PolisherError, match="unequal lengths"):
+        o.transmute(_seqs(), {"ctgt": 0, "r1q": 1}, {})
+
+
+def test_transmute_by_id_mhap():
+    seqs = _seqs()
+    o = Overlap.from_mhap(2, 1, 0.1, 5, 0, 0, 40, 40, 0, 10, 50, 100)
+    # q id 1 (0-based), t id 0
+    o.transmute(seqs, {}, {1 << 1 | 0: 1, 0 << 1 | 1: 0})
+    assert o.is_transmuted and o.q_id == 1 and o.t_id == 0
+
+
+def test_sam_t_length_backfilled():
+    seqs = _seqs()
+    o = Overlap.from_sam("r1", 0, "ctg", 11, "40M")
+    o.transmute(seqs, {"ctgt": 0, "r1q": 1}, {})
+    assert o.t_length == len(seqs[0].data)
+
+
+def test_alignment_operands_reverse_strand():
+    target = Sequence("ctg", b"A" * 100)
+    read = Sequence("r1", b"ACGTACGTAA")
+    read.create_reverse_complement()
+    o = Overlap.from_paf("r1", 10, 2, 8, "-", "ctg", 100, 10, 16)
+    o.transmute([target, read], {"ctgt": 0, "r1q": 1}, {})
+    q, t = o.alignment_operands([target, read])
+    # reverse complement of ACGTACGTAA is TTACGTACGT; slice [10-8 : 10-2]
+    assert q == b"TTACGTACGT"[2:8]
+    assert t == b"A" * 6
