@@ -8,21 +8,23 @@ Architecture (vs. reference layers, see SURVEY.md):
 
   reference (C++/CPU, thread pool)        racon-tpu (JAX/TPU)
   --------------------------------        --------------------------------
-  bioparser (streaming format IO)     ->  racon_tpu.io (Python + C++ native)
+  bioparser (streaming format IO)     ->  racon_tpu.io.parsers
   Sequence/Overlap/Window domain      ->  racon_tpu.models.{sequence,overlap,window}
-  edlib NW alignment (per overlap)    ->  racon_tpu.native banded-NW (C++),
-                                          racon_tpu.ops.nw batched TPU kernel
-  spoa POA engine (per window,        ->  racon_tpu.ops.poa_jax: batched POA,
-    per-thread engines)                   vmapped over windows, sharded over
-                                          chips via racon_tpu.parallel
-  thread_pool task parallelism        ->  batch parallelism: windows are the
-                                          batch dim; chips via shard_map Mesh;
-                                          hosts via target shards (wrapper)
+  edlib NW alignment (per overlap)    ->  racon_tpu.native banded-NW (C++/ctypes)
+                                          + racon_tpu.ops.align batched device NW
+  spoa POA engine (per window,        ->  racon_tpu.ops.poa: batched
+    per-thread engines)                   backbone-anchored POA with iterative
+                                          refinement; windows/layers are the
+                                          batch dimension
+  thread_pool task parallelism        ->  batch parallelism: alignment jobs are
+                                          the batch dim; chips via shard_map
+                                          Mesh (racon_tpu.parallel); hosts via
+                                          target shards (racon_tpu.tools)
   Polisher orchestration              ->  racon_tpu.models.polisher
-  CLI (racon)                         ->  racon_tpu.cli (racon_tpu -m / console)
+  logger (phase timing/progress)      ->  racon_tpu.utils.logger
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 from racon_tpu.models.sequence import Sequence  # noqa: F401
 from racon_tpu.models.overlap import Overlap  # noqa: F401

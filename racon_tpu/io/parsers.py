@@ -67,23 +67,39 @@ class Parser:
             raise ParseError(f"[racon_tpu::io] error: unable to open file {path}")
         self.path = path
         self._iter: Optional[Iterator] = None
+        self._failed = False
 
     def reset(self) -> None:
         self._iter = None
+        self._failed = False
 
     def _records(self) -> Iterator[Tuple[object, int]]:
         raise NotImplementedError
 
     def parse(self, max_bytes: int = -1) -> Tuple[List[object], bool]:
+        if self._failed:
+            raise ParseError(
+                f"[racon_tpu::io] error: parser for {self.path} previously "
+                "failed; call reset() before reuse")
         if self._iter is None:
             self._iter = self._records()
         out: List[object] = []
         consumed = 0
-        for rec, nbytes in self._iter:
-            out.append(rec)
-            consumed += nbytes
-            if 0 <= max_bytes <= consumed:
-                return out, True
+        try:
+            for rec, nbytes in self._iter:
+                out.append(rec)
+                consumed += nbytes
+                if 0 <= max_bytes <= consumed:
+                    return out, True
+        except (gzip.BadGzipFile, EOFError, OSError) as exc:
+            # A mislabelled .gz (or truncated stream) must surface as this
+            # parser's own error contract, not a raw gzip exception. Mark
+            # the parser failed so a retried parse() cannot masquerade as a
+            # clean EOF.
+            self._failed = True
+            raise ParseError(
+                f"[racon_tpu::io] error: corrupt or mislabelled input file "
+                f"{self.path} ({exc})") from exc
         self._iter = iter(())  # exhausted
         return out, False
 

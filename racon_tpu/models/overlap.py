@@ -215,6 +215,8 @@ class Overlap:
         selected exactly as the reference does (src/overlap.cpp:194-197)."""
         seq = sequences[self.q_id]
         if self.strand:
+            if seq.reverse_complement is None:
+                seq.create_reverse_complement()
             q = seq.reverse_complement[self.q_length - self.q_end:
                                       self.q_length - self.q_begin]
         else:
@@ -273,6 +275,12 @@ def breaking_points_from_cigar(cigar: bytes, t_begin: int, t_end: int,
     t0 = t_pos[is_match]
     q0 = q_pos[is_match]
     n = lens[is_match]
+    # Clamp the walk at t_end: the reference's base-by-base loop never steps a
+    # target pointer past t_end, so a truncated/inconsistent CIGAR stays
+    # bounded instead of silently diverging (src/overlap.cpp:232-279).
+    n = np.minimum(n, np.maximum(t_end - t0, 0))
+    keep = n > 0
+    t0, q0, n = t0[keep], q0[keep], n[keep]
     if len(t0) == 0:
         return np.zeros((0, 4), dtype=np.int64)
 

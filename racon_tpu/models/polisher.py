@@ -1,0 +1,339 @@
+"""Polisher: end-to-end orchestration from input files to polished contigs.
+
+TPU-first re-design of the reference's Polisher (src/polisher.{hpp,cpp}).
+The preprocessing pipeline keeps the reference's semantics step for step
+(citations inline); the execution model changes where the reference uses a
+thread pool:
+
+- per-overlap edlib alignments (src/polisher.cpp:351-364) become one
+  batched native/banded-NW call (racon_tpu/native) or a device batch;
+- per-window spoa tasks (src/polisher.cpp:457-469) become PoaEngine
+  batches with windows as the batch dimension (racon_tpu/ops/poa.py).
+"""
+
+from __future__ import annotations
+
+import enum
+import sys
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from racon_tpu.io import parsers as iop
+from racon_tpu.models.overlap import Overlap, PolisherError
+from racon_tpu.models.sequence import Sequence
+from racon_tpu.models.window import Window, WindowType
+from racon_tpu.ops.poa import PoaEngine
+from racon_tpu.utils.logger import Logger, NullLogger
+
+# Streaming chunk size for reads/overlaps (src/polisher.cpp:22).
+CHUNK_SIZE = 1024 * 1024 * 1024
+
+
+class PolisherType(enum.Enum):
+    kC = 0  # contig polishing (default)
+    kF = 1  # fragment error-correction (-f)
+
+
+class PolishedSequence:
+    """Output record: polished contig with its FASTA header tags."""
+    __slots__ = ("name", "data")
+
+    def __init__(self, name: str, data: bytes):
+        self.name = name
+        self.data = data
+
+
+def create_polisher(sequences_path: str, overlaps_path: str,
+                    target_path: str, type_: PolisherType = PolisherType.kC,
+                    window_length: int = 500, quality_threshold: float = 10.0,
+                    error_threshold: float = 0.3, match: int = 5,
+                    mismatch: int = -4, gap: int = -8,
+                    backend: str = "auto", logger: Optional[Logger] = None
+                    ) -> "Polisher":
+    """Validate options and dispatch parsers (src/polisher.cpp:51-130)."""
+    if not isinstance(type_, PolisherType):
+        raise PolisherError(
+            "[racon_tpu::create_polisher] error: invalid polisher type!")
+    if window_length <= 0:
+        raise PolisherError(
+            "[racon_tpu::create_polisher] error: invalid window length!")
+    sparser = iop.create_sequence_parser(sequences_path)
+    oparser = iop.create_overlap_parser(overlaps_path)
+    tparser = iop.create_sequence_parser(target_path)
+    return Polisher(sparser, oparser, tparser, type_, window_length,
+                    quality_threshold, error_threshold, match, mismatch,
+                    gap, backend=backend, logger=logger)
+
+
+class Polisher:
+    def __init__(self, sparser, oparser, tparser, type_: PolisherType,
+                 window_length: int, quality_threshold: float,
+                 error_threshold: float, match: int, mismatch: int,
+                 gap: int, backend: str = "auto",
+                 logger: Optional[Logger] = None,
+                 window_chunk: int = 8192):
+        self.sparser = sparser
+        self.oparser = oparser
+        self.tparser = tparser
+        self.type = type_
+        self.window_length = window_length
+        self.quality_threshold = quality_threshold
+        self.error_threshold = error_threshold
+        self.engine = PoaEngine(match, mismatch, gap, backend=backend)
+        self.logger = logger if logger is not None else NullLogger()
+        self.window_chunk = window_chunk
+
+        self.sequences: List[Sequence] = []
+        self.windows: List[Window] = []
+        self.targets_coverages: List[int] = []
+        self._targets_size = 0
+        self._window_type = WindowType.TGS
+
+    # ------------------------------------------------------------ initialize
+
+    def initialize(self) -> None:
+        """Preprocess inputs into windows (src/polisher.cpp:162-449)."""
+        if self.windows:
+            print("[racon_tpu::Polisher::initialize] warning: "
+                  "object already initialized!", file=sys.stderr)
+            return
+        log = self.logger
+        log.begin()
+
+        # 1. Targets (src/polisher.cpp:172-187).
+        self.tparser.reset()
+        self.sequences = list(self.tparser.parse_all())
+        targets_size = len(self.sequences)
+        if targets_size == 0:
+            raise PolisherError(
+                "[racon_tpu::Polisher::initialize] error: "
+                "empty target sequences set!")
+        self._targets_size = targets_size
+
+        name_to_id: Dict[str, int] = {}
+        id_to_id: Dict[int, int] = {}
+        for i, seq in enumerate(self.sequences):
+            name_to_id[seq.name + "t"] = i
+            id_to_id[i << 1 | 1] = i
+
+        has_name = [True] * targets_size
+        has_data = [True] * targets_size
+        has_reverse = [False] * targets_size
+
+        log.phase("[racon_tpu::Polisher::initialize] loaded target sequences")
+        log.begin()
+
+        # 2. Reads, streamed and deduplicated against targets
+        # (src/polisher.cpp:196-234).
+        sequences_size = 0
+        total_len = 0
+        self.sparser.reset()
+        while True:
+            chunk, more = self.sparser.parse(CHUNK_SIZE)
+            for seq in chunk:
+                total_len += len(seq.data)
+                tid = name_to_id.get(seq.name + "t")
+                if tid is not None:
+                    tgt = self.sequences[tid]
+                    if len(seq.data) != len(tgt.data) or \
+                            len(seq.quality or b"") != len(tgt.quality or b""):
+                        raise PolisherError(
+                            "[racon_tpu::Polisher::initialize] error: "
+                            f"duplicate sequence {seq.name} with unequal data")
+                    name_to_id[seq.name + "q"] = tid
+                    id_to_id[sequences_size << 1 | 0] = tid
+                else:
+                    idx = len(self.sequences)
+                    self.sequences.append(seq)
+                    name_to_id[seq.name + "q"] = idx
+                    id_to_id[sequences_size << 1 | 0] = idx
+                sequences_size += 1
+            if not more:
+                break
+        if sequences_size == 0:
+            raise PolisherError(
+                "[racon_tpu::Polisher::initialize] error: "
+                "empty sequences set!")
+
+        n_seqs = len(self.sequences)
+        has_name += [False] * (n_seqs - targets_size)
+        has_data += [False] * (n_seqs - targets_size)
+        has_reverse += [False] * (n_seqs - targets_size)
+
+        # NGS/TGS heuristic: mean read length (src/polisher.cpp:246-247).
+        self._window_type = WindowType.NGS \
+            if total_len / sequences_size <= 1000 else WindowType.TGS
+
+        log.phase("[racon_tpu::Polisher::initialize] loaded sequences")
+        log.begin()
+
+        # 3. Overlaps, streamed; per-q_id-group filtering
+        # (src/polisher.cpp:252-325).
+        overlaps: List[Overlap] = []
+        group: List[Overlap] = []
+
+        def flush_group():
+            kept = _filter_overlap_group(group, self.error_threshold,
+                                         self.type)
+            for o in kept:
+                if o.strand:
+                    has_reverse[o.q_id] = True
+                else:
+                    has_data[o.q_id] = True
+            overlaps.extend(kept)
+            group.clear()
+
+        self.oparser.reset()
+        while True:
+            chunk, more = self.oparser.parse(CHUNK_SIZE)
+            for o in chunk:
+                o.transmute(self.sequences, name_to_id, id_to_id)
+                if not o.is_valid:
+                    continue
+                if group and group[-1].q_id != o.q_id:
+                    flush_group()
+                group.append(o)
+            if not more:
+                break
+        flush_group()
+        del name_to_id, id_to_id
+
+        if not overlaps:
+            raise PolisherError(
+                "[racon_tpu::Polisher::initialize] error: "
+                "empty overlap set!")
+
+        log.phase("[racon_tpu::Polisher::initialize] loaded overlaps")
+        log.begin()
+
+        # 4. Sequence transmute: build reverse complements where some
+        # overlap needs them, free what nothing references
+        # (src/polisher.cpp:339-348).
+        for i, seq in enumerate(self.sequences):
+            seq.transmute(has_name[i], has_data[i], has_reverse[i])
+
+        # 5. Breaking points; PAF/MHAP overlaps need a global alignment
+        # first — one batched native call replaces the per-overlap edlib
+        # fan-out (src/polisher.cpp:351-364, overlap.cpp:194-213).
+        pending = [o for o in overlaps if len(o.cigar) == 0]
+        if pending:
+            from racon_tpu.native.aligner import NativeAligner
+            from racon_tpu.ops.cigar import ops_to_cigar
+            from racon_tpu.ops.encode import encode_bases
+            aligner = NativeAligner()  # edit-distance scoring, like edlib
+            pairs = []
+            for o in pending:
+                q, t = o.alignment_operands(self.sequences)
+                pairs.append((encode_bases(bytes(q)), encode_bases(bytes(t))))
+            for o, ops in zip(pending, aligner.align_batch(pairs)):
+                o.cigar = ops_to_cigar(ops)
+        for i, o in enumerate(overlaps):
+            o.find_breaking_points(self.sequences, self.window_length)
+            if len(overlaps) >= 20 and (i + 1) % (len(overlaps) // 20) == 0:
+                log.tick("[racon_tpu::Polisher::initialize] aligning overlaps")
+        log.phase("[racon_tpu::Polisher::initialize] aligned overlaps")
+        log.begin()
+
+        # 6. Cut targets into windows (src/polisher.cpp:373-388).
+        w_len = self.window_length
+        id_to_first_window = [0] * (targets_size + 1)
+        for i in range(targets_size):
+            tgt = self.sequences[i]
+            data = memoryview(tgt.data)
+            qual = memoryview(tgt.quality) if tgt.quality is not None else None
+            k = 0
+            for j in range(0, len(tgt.data), w_len):
+                e = min(j + w_len, len(tgt.data))
+                self.windows.append(Window(
+                    i, k, self._window_type, data[j:e],
+                    qual[j:e] if qual is not None else None))
+                k += 1
+            id_to_first_window[i + 1] = id_to_first_window[i] + k
+
+        # 7. Route overlap segments into windows with the 2%-span and
+        # mean-quality filters (src/polisher.cpp:390-446).
+        self.targets_coverages = [0] * targets_size
+        for o in overlaps:
+            self.targets_coverages[o.t_id] += 1
+            seq = self.sequences[o.q_id]
+            bps = o.breaking_points
+            if bps is None:
+                continue
+            data = seq.reverse_complement if o.strand else seq.data
+            qual = seq.reverse_quality if o.strand else seq.quality
+            dmv = memoryview(data) if data is not None else None
+            qmv = memoryview(qual) if qual is not None else None
+            for first_t, first_q, last_t1, last_q1 in bps:
+                if last_q1 - first_q < 0.02 * w_len:
+                    continue
+                if qual is not None:
+                    avg = seq.mean_quality(int(first_q), int(last_q1),
+                                           reverse=o.strand)
+                    if avg is not None and avg < self.quality_threshold:
+                        continue
+                window_id = id_to_first_window[o.t_id] + first_t // w_len
+                window_start = (first_t // w_len) * w_len
+                self.windows[window_id].add_layer(
+                    dmv[first_q:last_q1],
+                    qmv[first_q:last_q1] if qmv is not None else None,
+                    int(first_t - window_start),
+                    int(last_t1 - window_start - 1))
+            o.breaking_points = None  # freed (src/polisher.cpp:445)
+
+        log.phase("[racon_tpu::Polisher::initialize] "
+                  "transformed data into windows")
+
+    # ----------------------------------------------------------------- polish
+
+    def polish(self, drop_unpolished_sequences: bool = True
+               ) -> List[PolishedSequence]:
+        """Batch windows through the engine, stitch contigs in order, tag
+        and emit (src/polisher.cpp:451-513)."""
+        log = self.logger
+        log.begin()
+
+        n_windows = len(self.windows)
+        for s in range(0, n_windows, self.window_chunk):
+            self.engine.consensus_windows(self.windows[s:s + self.window_chunk])
+            log.tick("[racon_tpu::Polisher::polish] generating consensus")
+
+        dst: List[PolishedSequence] = []
+        polished_data: List[bytes] = []
+        num_polished = 0
+        for i, w in enumerate(self.windows):
+            num_polished += 1 if w.polished else 0
+            polished_data.append(w.consensus or b"")
+            last = (i == n_windows - 1) or (self.windows[i + 1].rank == 0)
+            if last:
+                ratio = num_polished / (w.rank + 1)
+                if not drop_unpolished_sequences or ratio > 0:
+                    data = b"".join(polished_data)
+                    tags = "r" if self.type == PolisherType.kF else ""
+                    tags += f" LN:i:{len(data)}"
+                    tags += f" RC:i:{self.targets_coverages[w.id]}"
+                    tags += f" XC:f:{ratio:.6f}"
+                    dst.append(PolishedSequence(
+                        self.sequences[w.id].name + tags, data))
+                num_polished = 0
+                polished_data.clear()
+
+        log.phase("[racon_tpu::Polisher::polish] generated consensus")
+        self.windows = []
+        return dst
+
+
+def _filter_overlap_group(group: List[Overlap], error_threshold: float,
+                          type_: PolisherType) -> List[Overlap]:
+    """Drop high-error and self overlaps; in kC keep only the longest
+    overlap per query (src/polisher.cpp:254-278 — the reference's pairwise
+    elimination keeps the last occurrence of the maximum length)."""
+    kept = [o for o in group
+            if o.error <= error_threshold and o.q_id != o.t_id]
+    if not kept or type_ != PolisherType.kC:
+        return kept
+    best = kept[0]
+    for o in kept[1:]:
+        if o.length >= best.length:
+            best = o
+    return [best]

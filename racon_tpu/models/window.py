@@ -81,7 +81,10 @@ class Window:
         if quality is not None and len(data) != len(quality):
             raise PolisherError(
                 "[racon_tpu::Window::add_layer] error: unequal quality size!")
-        if begin >= end or begin > len(self.backbone) or end > len(self.backbone):
+        # begin < 0 also rejected: the reference's uint32_t coercion makes
+        # negative positions enormous and they fail its bounds check.
+        if begin < 0 or begin >= end or begin > len(self.backbone) or \
+                end > len(self.backbone):
             raise PolisherError(
                 "[racon_tpu::Window::add_layer] error: "
                 "layer begin and end positions are invalid!")
@@ -140,10 +143,26 @@ class WindowBatch:
 
     __slots__ = ("windows", "backbone", "backbone_w", "backbone_len",
                  "layers", "layer_w", "layer_len", "layer_begin", "layer_end",
-                 "n_layers")
+                 "n_layers", "dropped_layers", "truncated_bases")
 
-    def __init__(self, windows: List[Window], max_layers: int, max_len: int):
+    def __init__(self, windows: List[Window], max_layers: int, max_len: int,
+                 allow_truncate: bool = False):
         B, C, L = len(windows), max_layers, max_len
+        # No silent caps: the reference consumes every layer in full
+        # (src/window.cpp:74-107), so caps below the batch maxima are an
+        # error unless the caller explicitly opts into truncation, in which
+        # case the damage is counted and queryable.
+        need_c = max((w.n_layers for w in windows), default=0)
+        need_l = max((max([len(w.backbone)] +
+                          [len(d) for d in w.layer_data])
+                      for w in windows), default=0)
+        if not allow_truncate and (need_c > C or need_l > L):
+            raise PolisherError(
+                f"[racon_tpu::WindowBatch] error: caps (layers={C}, len={L}) "
+                f"below batch maxima (layers={need_c}, len={need_l}); pass "
+                f"allow_truncate=True to accept degraded consensus")
+        self.dropped_layers = 0
+        self.truncated_bases = 0
         self.windows = windows
         self.backbone = np.zeros((B, L), dtype=np.uint8)
         self.backbone_w = np.zeros((B, L), dtype=np.uint8)
@@ -156,18 +175,22 @@ class WindowBatch:
         self.n_layers = np.zeros(B, dtype=np.int32)
 
         for b, w in enumerate(windows):
-            lb = len(w.backbone)
+            lb = min(len(w.backbone), L)
+            self.truncated_bases += len(w.backbone) - lb
             self.backbone_len[b] = lb
-            self.backbone[b, :lb] = encode_bases(bytes(w.backbone))
+            self.backbone[b, :lb] = encode_bases(bytes(w.backbone[:lb]))
             if w.backbone_quality is not None:
-                q = np.frombuffer(bytes(w.backbone_quality), dtype=np.uint8)
+                q = np.frombuffer(bytes(w.backbone_quality[:lb]),
+                                  dtype=np.uint8)
                 self.backbone_w[b, :lb] = q - 33
             order = sorted_layer_order(w)
             n = min(len(order), C)
             self.n_layers[b] = n
+            self.dropped_layers += len(order) - n
             for c, li in enumerate(order[:n]):
                 data = bytes(w.layer_data[li])
                 ll = min(len(data), L)
+                self.truncated_bases += len(data) - ll
                 self.layer_len[b, c] = ll
                 self.layers[b, c, :ll] = encode_bases(data[:ll])
                 qual = w.layer_quality[li]

@@ -1,0 +1,50 @@
+"""Build the native C++ runtime pieces (g++ -> shared library).
+
+The reference links vendored native libs (edlib et al.) through CMake
+(reference: CMakeLists.txt:37); here the native aligner is a single
+translation unit compiled on demand and cached next to its source, keyed
+by a content hash so edits trigger a rebuild and stale binaries are never
+loaded. No pybind11 in this environment — bindings are ctypes
+(racon_tpu/native/aligner.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "nw.cpp")
+_CXX = os.environ.get("CXX", "g++")
+_FLAGS = ["-O3", "-std=c++17", "-shared", "-fPIC", "-march=native",
+          "-funroll-loops", "-Wall", "-Wextra"]
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def _source_hash() -> str:
+    with open(_SRC, "rb") as f:
+        return hashlib.sha256(f.read() + " ".join(_FLAGS).encode()).hexdigest()[:16]
+
+
+def shared_library_path(rebuild: bool = False) -> str:
+    """Path to the compiled library, building it if missing or stale."""
+    tag = _source_hash()
+    lib = os.path.join(_DIR, f"libracon_nw.{tag}.so")
+    if rebuild or not os.path.isfile(lib):
+        for old in os.listdir(_DIR):
+            if old.startswith("libracon_nw.") and old.endswith(".so"):
+                try:
+                    os.unlink(os.path.join(_DIR, old))
+                except OSError:
+                    pass
+        cmd = [_CXX, *_FLAGS, _SRC, "-o", lib]
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise NativeBuildError(
+                f"[racon_tpu::native] error: build failed\n$ {' '.join(cmd)}\n"
+                f"{proc.stderr}")
+    return lib

@@ -1,0 +1,197 @@
+// Banded Needleman-Wunsch global aligner with traceback.
+//
+// Native (host) replacement for the reference's edlib dependency: racon
+// calls edlibAlign(..., EDLIB_MODE_NW, EDLIB_TASK_PATH) once per PAF/MHAP
+// overlap to recover a CIGAR (reference: src/overlap.cpp:198-213). Overlap
+// spans reach tens of kilobases, so the full O(Lq*Lt) matrix is avoided
+// with a diagonal band that doubles until the optimal path stays strictly
+// inside it (the same adaptive-band idea edlib uses); a band covering the
+// whole matrix is exact plain NW, so the loop always terminates with an
+// optimal alignment.
+//
+// Semantics are kept identical to the JAX device kernel
+// (racon_tpu/ops/align.py): linear gap, int32 scores, tie preference
+// DIAG > UP > LEFT, op encoding 0=M (diag), 1=I (up, consumes query),
+// 2=D (left, consumes target).
+//
+// Band coordinates: k = j - i, band k in [klo, khi], column b = k - klo.
+// Moving to row i+1: diag neighbour keeps b, up neighbour is b+1 in the
+// previous row, left neighbour is b-1 in the same row.
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+#include <algorithm>
+
+namespace {
+
+constexpr int32_t kNegInf = INT32_MIN / 4;
+enum Dir : uint8_t { kDiag = 0, kUp = 1, kLeft = 2 };
+
+struct BandResult {
+    int32_t n_ops = -1;
+    int32_t score = kNegInf;
+    bool touched_edge = false;
+};
+
+// One banded pass. ops_out is filled back-to-front and left in
+// start->end order on return.
+BandResult band_pass(const uint8_t* q, int32_t lq, const uint8_t* t,
+                     int32_t lt, int32_t m, int32_t x, int32_t g,
+                     int32_t klo, int32_t khi, uint8_t* ops_out) {
+    BandResult res;
+    const int32_t bandw = khi - klo + 1;
+    const bool full = (klo <= -lq) && (khi >= lt);
+    // A band side clamped to the matrix boundary is a real edge, not an
+    // artificial cut — touching it must not trigger band doubling.
+    const bool lo_artificial = klo > -lq;
+    const bool hi_artificial = khi < lt;
+
+    std::vector<uint8_t> dirs(static_cast<size_t>(lq + 1) * bandw);
+    std::vector<int32_t> prev(bandw + 1, kNegInf), cur(bandw + 1, kNegInf);
+    // prev/cur have one sentinel slot at the end so the up-neighbour read
+    // prev[b + 1] is always in range.
+
+    // Row 0: H[0][j] = j*g for j in [max(0, klo), min(lt, khi)].
+    {
+        const int32_t jlo = std::max(0, klo), jhi = std::min(lt, khi);
+        for (int32_t j = jlo; j <= jhi; ++j) {
+            prev[j - klo] = j * g;
+        }
+    }
+
+    for (int32_t i = 1; i <= lq; ++i) {
+        const int32_t jlo = std::max(0, i + klo);
+        const int32_t jhi = std::min(lt, i + khi);
+        if (jlo > jhi) return res;  // band fell off the matrix
+        const uint8_t qc = q[i - 1];
+        uint8_t* drow = dirs.data() + static_cast<size_t>(i) * bandw;
+        std::fill(cur.begin(), cur.end(), kNegInf);
+
+        int32_t blo = jlo - i - klo;
+        int32_t bhi = jhi - i - klo;
+        // Vectorizable phase: tmp = max(diag, up).
+        for (int32_t b = blo; b <= bhi; ++b) {
+            const int32_t j = i + klo + b;
+            const int32_t sub = (j >= 1 && t[j - 1] == qc) ? m : x;
+            const int32_t diag = (j >= 1 ? prev[b] : kNegInf) + sub;
+            const int32_t up = prev[b + 1] + g;
+            cur[b] = diag > up ? diag : up;
+        }
+        if (jlo == 0) cur[blo] = i * g;  // j = 0 boundary
+        // Serial phase: fold in the left-gap chain and label directions.
+        int32_t left = kNegInf;
+        for (int32_t b = blo; b <= bhi; ++b) {
+            const int32_t j = i + klo + b;
+            const int32_t sub = (j >= 1 && t[j - 1] == qc) ? m : x;
+            const int32_t diag = (j >= 1 ? prev[b] : kNegInf) + sub;
+            const int32_t up = prev[b + 1] + g;
+            int32_t h = cur[b];
+            if (left + g > h) h = left + g;
+            if (j == 0) h = i * g;
+            cur[b] = h;
+            left = h;
+            drow[b] = (h == diag) ? kDiag : (h == up ? kUp : kLeft);
+        }
+        std::swap(prev, cur);
+    }
+
+    const int32_t bend = lt - lq - klo;
+    if (bend < 0 || bend >= bandw) return res;
+    res.score = prev[bend];
+    if (res.score <= kNegInf / 2) return res;
+
+    // Traceback from (lq, lt).
+    int32_t i = lq, j = lt, pos = lq + lt;
+    while (i > 0 || j > 0) {
+        uint8_t d;
+        if (i == 0) {
+            d = kLeft;
+        } else if (j == 0) {
+            d = kUp;
+        } else {
+            const int32_t b = j - i - klo;
+            if (b < 0 || b >= bandw) return res;  // should not happen
+            if ((lo_artificial && b == 0) ||
+                (hi_artificial && b == bandw - 1)) {
+                res.touched_edge = true;
+            }
+            d = dirs[static_cast<size_t>(i) * bandw + b];
+        }
+        ops_out[--pos] = d;
+        if (d != kLeft) --i;
+        if (d != kUp) --j;
+    }
+    res.n_ops = lq + lt - pos;
+    if (pos > 0) {
+        std::memmove(ops_out, ops_out + pos, res.n_ops);
+    }
+    if (full) res.touched_edge = false;
+    return res;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Globally align q vs t; writes ops (0=M,1=I,2=D) into ops_out (capacity
+// lq + lt). Returns the op count, or -1 on failure. band0 <= 0 selects an
+// automatic initial half-width. score_out (optional) receives the score.
+int32_t racon_nw_align(const uint8_t* q, int32_t lq, const uint8_t* t,
+                       int32_t lt, int32_t m, int32_t x, int32_t g,
+                       int32_t band0, uint8_t* ops_out, int32_t* score_out) {
+    if (lq < 0 || lt < 0) return -1;
+    if (lq == 0) {
+        std::memset(ops_out, kLeft, lt);
+        if (score_out) *score_out = lt * g;
+        return lt;
+    }
+    if (lt == 0) {
+        std::memset(ops_out, kUp, lq);
+        if (score_out) *score_out = lq * g;
+        return lq;
+    }
+
+    int32_t w = band0 > 0 ? band0
+                          : std::max<int32_t>(128, std::abs(lt - lq) + 64);
+    while (true) {
+        const int32_t klo = std::max(std::min(0, lt - lq) - w, -lq);
+        const int32_t khi = std::min(std::max(0, lt - lq) + w, lt);
+        BandResult res = band_pass(q, lq, t, lt, m, x, g, klo, khi, ops_out);
+        if (res.n_ops >= 0 && !res.touched_edge) {
+            if (score_out) *score_out = res.score;
+            return res.n_ops;
+        }
+        if (klo <= -lq && khi >= lt) {
+            // Full matrix already — result is exact even if edge-marked.
+            if (res.n_ops >= 0) {
+                if (score_out) *score_out = res.score;
+                return res.n_ops;
+            }
+            return -1;
+        }
+        w *= 2;
+    }
+}
+
+// Batched form over flat buffers. ops_off[i] must leave q_len[i]+t_len[i]
+// capacity per record; ops_len[i] receives each op count (-1 on failure).
+// Returns 0 on success, first failing index + 1 otherwise.
+int32_t racon_nw_align_batch(const uint8_t* q, const int64_t* q_off,
+                             const int32_t* q_len, const uint8_t* t,
+                             const int64_t* t_off, const int32_t* t_len,
+                             int32_t n, int32_t m, int32_t x, int32_t g,
+                             int32_t band0, uint8_t* ops_out,
+                             const int64_t* ops_off, int32_t* ops_len) {
+    int32_t rc = 0;
+    for (int32_t i = 0; i < n; ++i) {
+        ops_len[i] = racon_nw_align(q + q_off[i], q_len[i], t + t_off[i],
+                                    t_len[i], m, x, g, band0,
+                                    ops_out + ops_off[i], nullptr);
+        if (ops_len[i] < 0 && rc == 0) rc = i + 1;
+    }
+    return rc;
+}
+
+}  // extern "C"

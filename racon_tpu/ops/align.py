@@ -1,0 +1,152 @@
+"""Batched Needleman-Wunsch alignment on device.
+
+This is the TPU replacement for both of the reference's alignment engines:
+
+- edlib's global (NW) alignment with path, used to derive CIGARs for
+  PAF/MHAP overlaps (reference: src/overlap.cpp:198-213), and
+- spoa's sequence-vs-graph kNW aligner inside window consensus
+  (reference: src/window.cpp:89-96) — our POA engine anchors every layer
+  to the window backbone, so layer alignment is plain sequence-vs-sequence
+  NW and batches perfectly over (window, layer) pairs.
+
+TPU-first design notes:
+- The DP is a ``lax.scan`` over query rows. The horizontal (gap-in-target)
+  dependency within a row is a max-plus prefix scan which, for a *linear*
+  gap penalty, reduces to ``lax.cummax`` over ``H[j] - j*gap`` — fully
+  vectorized on the VPU instead of a serial inner loop.
+- Direction bits (2 effective bits, stored uint8) live in HBM, never on the
+  host; traceback runs on device as a vmapped ``lax.while_loop`` and only
+  the compact op strings (<= Lq+Lt bytes each) leave the chip.
+- Scores are int32; all shapes are static (padded buckets), so one compile
+  per bucket shape serves the whole run.
+
+Op encoding (shared with the native C++ aligner, racon_tpu/native/nw.cpp):
+  0 = DIAG  (consumes query+target -> CIGAR 'M')
+  1 = UP    (consumes query only   -> CIGAR 'I')
+  2 = LEFT  (consumes target only  -> CIGAR 'D')
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from racon_tpu.ops.cigar import (DIAG, UP, LEFT,  # noqa: F401 (re-export)
+                                 nw_oracle, ops_to_cigar)
+
+
+def _nw_dirs(q: jnp.ndarray, t: jnp.ndarray, match: int, mismatch: int,
+             gap: int) -> jnp.ndarray:
+    """Direction matrix uint8[Lq, Lt] for one (padded) alignment.
+
+    H[i, j] = max(H[i-1, j-1] + s, H[i-1, j] + g, H[i, j-1] + g) with
+    H[0, j] = j*g, H[i, 0] = i*g. Tie preference DIAG > UP > LEFT.
+    """
+    Lq, Lt = q.shape[0], t.shape[0]
+    jr = jnp.arange(Lt + 1, dtype=jnp.int32)
+    row0 = jr * gap
+
+    def step(prev, inp):
+        i, qi = inp
+        sub = jnp.where(t == qi, match, mismatch).astype(jnp.int32)
+        diag = prev[:-1] + sub
+        up = prev[1:] + gap
+        tmp = jnp.maximum(diag, up)
+        # Left-chain closure: H[j] = max_{k<=j}(tmp'[k] + (j-k)*g) with the
+        # j=0 boundary folded in as tmp'[0] = i*g.
+        f = jnp.concatenate([(i * gap)[None], tmp]) - jr * gap
+        h = jax.lax.cummax(f) + jr * gap
+        hj = h[1:]
+        d = jnp.where(hj == diag, DIAG,
+                      jnp.where(hj == up, UP, LEFT)).astype(jnp.uint8)
+        return h, d
+
+    ii = jnp.arange(1, Lq + 1, dtype=jnp.int32)
+    _, dirs = jax.lax.scan(step, row0, (ii, q.astype(jnp.int32)))
+    return dirs
+
+
+def _traceback(dirs: jnp.ndarray, lq: jnp.ndarray, lt: jnp.ndarray):
+    """Walk the direction matrix from (lq, lt) back to (0, 0).
+
+    Returns (ops, n_ops): ops uint8[Lq+Lt] holds the alignment operations
+    right-aligned (ops[L-n_ops:] is the path in start->end order).
+    """
+    Lq, Lt = dirs.shape
+    L = Lq + Lt
+
+    def cond(state):
+        i, j, pos, _ = state
+        return (i > 0) | (j > 0)
+
+    def body(state):
+        i, j, pos, ops = state
+        d = jnp.where(i == 0, LEFT,
+                      jnp.where(j == 0, UP, dirs[i - 1, j - 1]))
+        d = d.astype(jnp.uint8)
+        ops = ops.at[pos].set(d)
+        i = i - jnp.where(d != LEFT, 1, 0).astype(i.dtype)
+        j = j - jnp.where(d != UP, 1, 0).astype(j.dtype)
+        return i, j, pos - 1, ops
+
+    ops0 = jnp.zeros((L,), dtype=jnp.uint8)
+    i, j, pos, ops = jax.lax.while_loop(
+        cond, body, (lq.astype(jnp.int32), lt.astype(jnp.int32),
+                     jnp.int32(L - 1), ops0))
+    return ops, (jnp.int32(L - 1) - pos)
+
+
+@functools.partial(jax.jit, static_argnames=("match", "mismatch", "gap"))
+def nw_align_batch(q: jnp.ndarray, t: jnp.ndarray, lq: jnp.ndarray,
+                   lt: jnp.ndarray, *, match: int, mismatch: int, gap: int):
+    """Batched global alignment with traceback.
+
+    Args:
+      q: uint8[B, Lq] query base codes, zero-padded.
+      t: uint8[B, Lt] target base codes, zero-padded.
+      lq, lt: int32[B] true lengths.
+    Returns:
+      ops uint8[B, Lq+Lt] (right-aligned per row), n_ops int32[B].
+    """
+    dirs = jax.vmap(
+        lambda a, b: _nw_dirs(a, b, match, mismatch, gap))(q, t)
+    return jax.vmap(_traceback)(dirs, lq, lt)
+
+
+@functools.partial(jax.jit, static_argnames=("match", "mismatch", "gap"))
+def nw_scores(q: jnp.ndarray, t: jnp.ndarray, lq: jnp.ndarray,
+              lt: jnp.ndarray, *, match: int, mismatch: int, gap: int):
+    """Batched NW final scores only (no traceback storage) — int32[B].
+
+    Used by benchmarks and as the compile-checked forward step: the DP scan
+    without direction materialization is the pure-compute core.
+    """
+
+    def one(qq, tt, a, b):
+        Lt = tt.shape[0]
+        jr = jnp.arange(Lt + 1, dtype=jnp.int32)
+        row0 = jr * gap
+
+        def step(prev, inp):
+            i, qi = inp
+            sub = jnp.where(tt == qi, match, mismatch).astype(jnp.int32)
+            tmp = jnp.maximum(prev[:-1] + sub, prev[1:] + gap)
+            f = jnp.concatenate([(i * gap)[None], tmp]) - jr * gap
+            h = jax.lax.cummax(f) + jr * gap
+            # Past the true query length, rows must stop evolving so the
+            # score can be read from the final carry at column b.
+            h = jnp.where(i <= a, h, prev)
+            return h, None
+
+        ii = jnp.arange(1, qq.shape[0] + 1, dtype=jnp.int32)
+        last, _ = jax.lax.scan(step, row0, (ii, qq.astype(jnp.int32)))
+        return last[b]
+
+    return jax.vmap(one)(q, t, lq.astype(jnp.int32), lt.astype(jnp.int32))
+
+
+# ops_to_cigar / nw_oracle live in racon_tpu.ops.cigar (numpy-only) and are
+# re-exported above for callers that already use the device kernel.

@@ -1,0 +1,431 @@
+"""Batched POA consensus engine — the spoa replacement.
+
+The reference runs one spoa partial-order-alignment graph per window on a
+CPU thread: each layer is NW-aligned against the *evolving* graph and
+merged into it, then consensus is the heaviest bundle through the DAG
+(reference: src/window.cpp:61-137; engine creation src/polisher.cpp:151-155).
+A sequence-vs-DAG DP with data-dependent predecessor sets serializes
+horribly on a TPU, so this engine restructures the computation:
+
+1. **Anchor to the backbone.** Every layer is globally aligned to its
+   window-relative backbone slice (the reference's subgraph range,
+   src/window.cpp:92-97). All alignments share the same static target, so
+   they batch perfectly over (window, layer) pairs — the entire hot loop
+   becomes one ``nw_align_batch`` call on device (or one native FFI call
+   on host), instead of C sequential graph alignments per window.
+2. **Merge columns on host.** Because all reads share backbone
+   coordinates, spoa's graph degenerates into a deterministic structure:
+   at most one node per (position, base) — mismatches merge by base
+   exactly as spoa's aligned-node rings do — plus insertion chains keyed
+   by (gap, inserted sequence), which merges reads carrying an identical
+   insertion at an identical spot (deterministic because all reads align
+   to the same target with the same tie-breaking).
+3. **Consensus by weighted column vote**: per position the heaviest of
+   {A, C, G, T, N, deletion}; per gap the inserted segments from all
+   reads form a left-justified mini-pileup whose columns are emitted
+   while the weight of reads extending the insertion beats the weight of
+   reads that have stopped (crossed directly or ran out of inserted
+   bases). This is the heaviest path through the merged DAG (the DAG is
+   chain-shaped, so the global heaviest path decomposes per column).
+
+Weights follow spoa's: per-base Phred (quality - 33) when quality exists,
+1 otherwise; the backbone caries its quality or the reference's dummy
+``'!'`` (= weight 0, src/polisher.cpp:141, 383). Per-base consensus
+coverage (number of sequences through the chosen node, backbone included)
+feeds the kTGS trim in ``Window.apply_consensus``.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from racon_tpu.models.window import Window, sorted_layer_order
+from racon_tpu.ops.encode import encode_bases, decode_bases, ALPHABET
+from racon_tpu.ops.cigar import DIAG, UP, LEFT
+
+_EPS = 1e-6
+
+
+class _Job:
+    """One layer-vs-backbone-slice alignment job."""
+    __slots__ = ("win", "q", "w", "w_read", "t", "t_off", "ops")
+
+    def __init__(self, win: int, q: np.ndarray, w: np.ndarray,
+                 t: np.ndarray, t_off: int):
+        self.win = win
+        self.q = q                      # uint8 base codes (query layer)
+        self.w = w                      # float32 per-base weights
+        self.w_read = float(w.mean()) if len(w) else 0.0
+        self.t = t                      # uint8 base codes (backbone slice)
+        self.t_off = t_off              # backbone offset of the slice
+        self.ops: Optional[np.ndarray] = None
+
+    @property
+    def t_len(self) -> int:
+        return len(self.t)
+
+
+def _round_up(n: int, mult: int = 128) -> int:
+    return ((max(n, 1) + mult - 1) // mult) * mult
+
+
+class PoaEngine:
+    """Batched consensus over windows.
+
+    backend:
+      "jax"    — device NW kernel (TPU; also runs on CPU via XLA)
+      "native" — C++ banded NW through ctypes (fast host path)
+      "auto"   — "jax" when an accelerator is present, else "native"
+    """
+
+    def __init__(self, match: int = 5, mismatch: int = -4, gap: int = -8,
+                 backend: str = "auto", device_batch: int = 512,
+                 refine_rounds: int = 3, ins_scale: float = 0.3,
+                 log=sys.stderr):
+        if gap >= 0:
+            raise ValueError(
+                "[racon_tpu::PoaEngine] error: gap penalty must be negative!")
+        self.match, self.mismatch, self.gap = match, mismatch, gap
+        self.device_batch = device_batch
+        # Refinement replays spoa's evolving-graph advantage in batched
+        # form: the first vote's consensus becomes the anchor for a second
+        # alignment round, so insertions scattered across adjacent gaps by
+        # backbone errors consolidate onto real columns.
+        self.refine_rounds = refine_rounds
+        # Insertion-vs-crossing vote scale (<1 counters the systematic
+        # deficit insertion columns suffer from alignment scatter).
+        self.ins_scale = ins_scale
+        self.log = log
+        if backend == "auto":
+            backend = "jax" if _accelerator_present() else "native"
+        self.backend = backend
+        self._native = None
+
+    # ------------------------------------------------------------ public API
+
+    def consensus_windows(self, windows: List[Window]) -> int:
+        """Fill ``consensus`` for every window; returns #polished.
+
+        Windows with fewer than backbone+2 sequences keep their backbone
+        and stay unpolished (src/window.cpp:63-66).
+        """
+        active: List[Window] = []
+        for w in windows:
+            if w.n_layers < 2:
+                w.set_backbone_consensus()
+            else:
+                active.append(w)
+        if not active:
+            return 0
+
+        # Per-window state: current anchor (codes, weights) and layer maps
+        # from original window coordinates into the current anchor.
+        layers: List[List[Tuple[np.ndarray, np.ndarray, int, int]]] = []
+        anchors: List[Tuple[np.ndarray, np.ndarray]] = []
+        spans: List[List[Tuple[int, int]]] = []
+        for w in active:
+            lst = []
+            sp = []
+            for li in sorted_layer_order(w):
+                data = bytes(w.layer_data[li])
+                qual = w.layer_quality[li]
+                codes = encode_bases(data)
+                if qual is not None:
+                    wts = (np.frombuffer(bytes(qual), dtype=np.uint8)
+                           .astype(np.float32) - 33.0)
+                else:
+                    wts = np.ones(len(data), dtype=np.float32)
+                lst.append((codes, wts))
+                sp.append((int(w.layer_begin[li]), int(w.layer_end[li])))
+            layers.append(lst)
+            spans.append(sp)
+            bb = encode_bases(bytes(w.backbone))
+            if w.backbone_quality is not None:
+                bb_w = (np.frombuffer(bytes(w.backbone_quality),
+                                      dtype=np.uint8)
+                        .astype(np.float32) - 33.0)
+            else:
+                bb_w = np.zeros(len(bb), dtype=np.float32)
+            anchors.append((bb, bb_w))
+
+        results = None
+        for _ in range(self.refine_rounds + 1):
+            jobs: List[_Job] = []
+            for wi in range(len(active)):
+                jobs.extend(self._build_jobs(wi, anchors[wi][0],
+                                             layers[wi], spans[wi]))
+            self._align(jobs)
+            by_win: List[List[_Job]] = [[] for _ in active]
+            for j in jobs:
+                by_win[j.win].append(j)
+            results = [self._merge(anchors[wi][0], anchors[wi][1], wjobs)
+                       for wi, wjobs in enumerate(by_win)]
+            # Next round anchors: the fresh consensus with neutral weights
+            # (reads re-vote from scratch); spans mapped through the merge.
+            new_anchors = []
+            new_spans = []
+            for wi, (cons, cov, map_b, map_e) in enumerate(results):
+                new_anchors.append(
+                    (cons, np.zeros(len(cons), dtype=np.float32)))
+                sp = []
+                for (b, e) in spans[wi]:
+                    nb = int(map_b[b]) if b < len(map_b) else 0
+                    ne = int(map_e[e]) if e < len(map_e) else len(cons) - 1
+                    sp.append((nb, ne))
+                new_spans.append(sp)
+            anchors = new_anchors
+            spans = new_spans
+
+        for w, (cons, cov, _, _) in zip(active, results):
+            w.apply_consensus(decode_bases(cons), cov, log=self.log)
+        return len(active)
+
+    # ------------------------------------------------------------- job build
+
+    def _build_jobs(self, wi: int, bb: np.ndarray,
+                    lst: List[Tuple[np.ndarray, np.ndarray]],
+                    sp: List[Tuple[int, int]]) -> List[_Job]:
+        L = len(bb)
+        offset = int(0.01 * L)
+        jobs = []
+        for (codes, wts), (begin, end) in zip(lst, sp):
+            begin = max(0, min(begin, L - 1))
+            end = max(begin, min(end, L - 1))
+            # Full-span layers align to the whole backbone, partial layers
+            # to the [begin, end] slice (src/window.cpp:82-98, 1% offset).
+            if begin < offset and end > L - offset - 1:
+                jobs.append(_Job(wi, codes, wts, bb, 0))
+            else:
+                jobs.append(_Job(wi, codes, wts, bb[begin:end + 1], begin))
+        return jobs
+
+    # ------------------------------------------------------------- alignment
+
+    def _align(self, jobs: List[_Job]) -> None:
+        if not jobs:
+            return
+        if self.backend == "native":
+            self._align_native(jobs)
+        else:
+            self._align_jax(jobs)
+
+    def _align_native(self, jobs: List[_Job]) -> None:
+        from racon_tpu.native.aligner import NativeAligner
+        if self._native is None:
+            self._native = NativeAligner(self.match, self.mismatch, self.gap)
+        pairs = [(j.q, j.t) for j in jobs]
+        for j, ops in zip(jobs, self._native.align_batch(pairs)):
+            j.ops = ops
+
+    def _align_jax(self, jobs: List[_Job]) -> None:
+        import jax.numpy as jnp
+        from racon_tpu.ops.align import nw_align_batch
+        # Bucket by (target, query) length so one long-target job does not
+        # inflate the padded DP for a whole chunk of short slices.
+        order = np.lexsort((np.asarray([len(j.q) for j in jobs]),
+                            np.asarray([j.t_len for j in jobs])))
+        bs = self.device_batch
+        for s in range(0, len(order), bs):
+            chunk = [jobs[i] for i in order[s:s + bs]]
+            Lq = _round_up(max(len(j.q) for j in chunk))
+            Lt = _round_up(max(j.t_len for j in chunk))
+            B = len(chunk)
+            q = np.zeros((B, Lq), np.uint8)
+            t = np.zeros((B, Lt), np.uint8)
+            lq = np.zeros(B, np.int32)
+            lt = np.zeros(B, np.int32)
+            for b, j in enumerate(chunk):
+                lq[b] = len(j.q)
+                lt[b] = j.t_len
+                q[b, :lq[b]] = j.q
+                t[b, :lt[b]] = j.t
+            ops, n = nw_align_batch(
+                jnp.asarray(q), jnp.asarray(t), jnp.asarray(lq),
+                jnp.asarray(lt), match=self.match, mismatch=self.mismatch,
+                gap=self.gap)
+            ops = np.asarray(ops)
+            n = np.asarray(n)
+            W = ops.shape[1]
+            for b, j in enumerate(chunk):
+                j.ops = ops[b, W - int(n[b]):]
+
+    # ----------------------------------------------------------------- merge
+
+    def _merge(self, bb: np.ndarray, bb_w: np.ndarray, jobs: List[_Job]
+               ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """Column-merge aligned jobs against the anchor ``bb``.
+
+        Returns (consensus_codes, coverage, map_b, map_e) where map_b[p] /
+        map_e[p] give, for every anchor position p, the consensus index of
+        the first kept column >= p / last kept column <= p — the
+        coordinate maps refinement rounds use to re-slice layer spans.
+        """
+        L = len(bb)
+        base_w = np.zeros((L, ALPHABET), dtype=np.float64)
+        base_c = np.zeros((L, ALPHABET), dtype=np.int32)
+        del_w = np.zeros(L, dtype=np.float64)
+        # Gap g = insertion point before backbone position g (g in 0..L).
+        # direct_w[g] = weight of reads crossing g without inserting;
+        # ins[g] = left-justified pileup of inserted segments at g.
+        direct_w = np.zeros(L + 1, dtype=np.float64)
+        ins: Dict[int, "_InsPileup"] = {}
+
+        # Backbone is sequence 0 (src/window.cpp:34-37): epsilon keeps its
+        # base winning argmax ties at zero read coverage.
+        pos = np.arange(L)
+        base_w[pos, bb] += bb_w + _EPS
+        base_c[pos, bb] += 1
+        bb_cross = (np.concatenate([[bb_w[0]], bb_w]) +
+                    np.concatenate([bb_w, [bb_w[-1]]])) * 0.5
+        direct_w += bb_cross + _EPS
+
+        for j in jobs:
+            o = j.ops
+            consumes_q = o != LEFT
+            consumes_t = o != UP
+            qpos = np.cumsum(consumes_q) - consumes_q  # q index per op
+            tpos = j.t_off + np.cumsum(consumes_t) - consumes_t
+
+            m = o == DIAG
+            mq, mt = qpos[m], tpos[m]
+            np.add.at(base_w, (mt, j.q[mq]), j.w[mq])
+            np.add.at(base_c, (mt, j.q[mq]), 1)
+
+            d = o == LEFT
+            if d.any():
+                np.add.at(del_w, tpos[d], j.w_read)
+
+            # Direct crossings, weighted by the *local* flanking base
+            # qualities: inserted/uncertain bases carry low Phred scores in
+            # long reads, so a gap's "no insertion here" evidence must be
+            # judged against quality in the same neighbourhood, not the
+            # read-global mean.
+            t_idx = np.flatnonzero(consumes_t)
+            if len(t_idx) > 1:
+                # qpos can reach len(q) on trailing deletions; clamp — those
+                # ops take the w_read branch anyway.
+                qp = np.minimum(qpos, len(j.w) - 1)
+                wq = np.where(o == DIAG, j.w[qp], j.w_read)
+                adj = np.diff(t_idx) == 1  # no I ops between -> crossed
+                g_cross = tpos[t_idx[1:]][adj]
+                w_cross = 0.5 * (wq[t_idx[:-1]][adj] + wq[t_idx[1:]][adj])
+                np.add.at(direct_w, g_cross, w_cross)
+
+            i_mask = o == UP
+            if i_mask.any():
+                flat = np.flatnonzero(i_mask)
+                run_starts = flat[np.concatenate(
+                    [[True], np.diff(flat) > 1])]
+                run_ends = flat[np.concatenate([np.diff(flat) > 1, [True]])]
+                for s, e in zip(run_starts, run_ends):
+                    g = int(tpos[s])
+                    qs, qe = int(qpos[s]), int(qpos[e])
+                    pile = ins.get(g)
+                    if pile is None:
+                        pile = ins[g] = _InsPileup()
+                    pile.add(j.q[qs:qe + 1], j.w[qs:qe + 1])
+
+        # Column votes.
+        best_code = np.argmax(base_w, axis=1)
+        best_w = base_w[pos, best_code]
+        kept = del_w <= best_w
+        cov = base_c[pos, best_code]
+
+        # Insertion columns: keep emitting while reads extending the
+        # insertion outweigh reads that have stopped (direct crossings plus
+        # shorter insertions).
+        ins_events: List[Tuple[int, np.ndarray, np.ndarray]] = []
+        ins_len_at = np.zeros(L + 1, dtype=np.int64)
+        for g, pile in ins.items():
+            seq, cnt = pile.consensus(direct_w[g] * self.ins_scale)
+            if len(seq):
+                ins_events.append((g, seq, cnt))
+                ins_len_at[g] = len(seq)
+        ins_events.sort(key=lambda e: e[0])
+
+        # Assemble consensus + per-base coverage.
+        parts: List[np.ndarray] = []
+        covs: List[np.ndarray] = []
+        last = 0
+        for g, seq, cnt in ins_events:
+            sel = kept[last:g]
+            parts.append(best_code[last:g][sel])
+            covs.append(cov[last:g][sel])
+            parts.append(seq)
+            covs.append(cnt)
+            last = g
+        sel = kept[last:]
+        parts.append(best_code[last:][sel])
+        covs.append(cov[last:][sel])
+        consensus = np.concatenate(parts).astype(np.uint8) if parts else \
+            np.zeros(0, np.uint8)
+        coverage = np.concatenate(covs).astype(np.int32) if covs else \
+            np.zeros(0, np.int32)
+
+        # Coordinate maps anchor->consensus for refinement re-slicing.
+        kept_excl = np.cumsum(kept) - kept          # kept columns before p
+        ins_before = np.cumsum(ins_len_at)[:L]      # inserted bases at g<=p
+        new_col = kept_excl + ins_before            # index where p landed
+        kept_idx = np.flatnonzero(kept)
+        ar = np.arange(L)
+        if len(kept_idx) == 0:
+            map_b = np.zeros(L, dtype=np.int64)
+            map_e = np.zeros(L, dtype=np.int64)
+        else:
+            nb = np.searchsorted(kept_idx, ar, side="left")
+            map_b = new_col[kept_idx[np.minimum(nb, len(kept_idx) - 1)]]
+            ne = np.searchsorted(kept_idx, ar, side="right") - 1
+            map_e = new_col[kept_idx[np.maximum(ne, 0)]]
+        np.clip(map_b, 0, max(len(consensus) - 1, 0), out=map_b)
+        np.clip(map_e, 0, max(len(consensus) - 1, 0), out=map_e)
+        return consensus, coverage, map_b, map_e
+
+
+class _InsPileup:
+    """Left-justified pileup of inserted segments at one backbone gap.
+
+    Columns are voted independently; emission continues while the weight
+    of reads still extending the insertion beats the weight of reads that
+    stopped (direct crossings + shorter insertions) — the column-local
+    heaviest-path criterion.
+    """
+    __slots__ = ("col_w", "col_c", "len_w")
+
+    def __init__(self):
+        self.col_w: List[np.ndarray] = []
+        self.col_c: List[np.ndarray] = []
+        self.len_w: Dict[int, float] = {}
+
+    def add(self, seg: np.ndarray, w: np.ndarray) -> None:
+        for k in range(len(seg)):
+            if k == len(self.col_w):
+                self.col_w.append(np.zeros(ALPHABET, dtype=np.float64))
+                self.col_c.append(np.zeros(ALPHABET, dtype=np.int32))
+            self.col_w[k][seg[k]] += w[k]
+            self.col_c[k][seg[k]] += 1
+        self.len_w[len(seg)] = self.len_w.get(len(seg), 0.0) + float(w.mean())
+
+    def consensus(self, direct: float) -> Tuple[np.ndarray, np.ndarray]:
+        out: List[int] = []
+        cnt: List[int] = []
+        stopped = float(direct)
+        for k in range(len(self.col_w)):
+            if self.col_w[k].sum() <= stopped:
+                break
+            b = int(np.argmax(self.col_w[k]))
+            out.append(b)
+            cnt.append(int(self.col_c[k][b]))
+            stopped += self.len_w.get(k + 1, 0.0)
+        return (np.asarray(out, dtype=np.uint8),
+                np.asarray(cnt, dtype=np.int32))
+
+
+def _accelerator_present() -> bool:
+    try:
+        import jax
+        return any(d.platform != "cpu" for d in jax.devices())
+    except Exception:
+        return False

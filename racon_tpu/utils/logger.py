@@ -1,0 +1,66 @@
+"""Phase timing + progress logging (logger equivalent).
+
+Mirrors the reference's vendored logger API as used by the Polisher
+(reference: src/polisher.cpp:144,159,170-509): ``()`` starts/resets a
+phase timer, ``("msg")`` prints elapsed time + message, ``["msg"]`` ticks
+a 20-step progress bar, ``total("msg")`` prints total runtime. All output
+goes to stderr so stdout stays clean FASTA.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+
+class Logger:
+    def __init__(self, stream=None):
+        self.stream = stream if stream is not None else sys.stderr
+        self._t0 = time.perf_counter()
+        self._phase_t0 = self._t0
+        self._bar = 0
+
+    def begin(self) -> None:
+        """Start/reset the phase timer — the reference's ``(*logger)()``."""
+        self._phase_t0 = time.perf_counter()
+        self._bar = 0
+
+    def phase(self, msg: str) -> None:
+        """Print elapsed phase time — the reference's ``(*logger)("msg")``."""
+        elapsed = time.perf_counter() - self._phase_t0
+        print(f"{msg} {elapsed:.6f} s", file=self.stream)
+
+    def tick(self, msg: str) -> None:
+        """Advance a 20-step progress bar — ``(*logger)["msg"]``."""
+        self._bar = min(self._bar + 1, 20)
+        bar = "=" * self._bar + " " * (20 - self._bar)
+        elapsed = time.perf_counter() - self._phase_t0
+        end = "\n" if self._bar == 20 else ""
+        print(f"\r{msg} [{bar}] {elapsed:.6f} s", end=end,
+              file=self.stream, flush=True)
+        if self._bar == 20:
+            self._bar = 0
+
+    def total(self, msg: str) -> None:
+        """Print total wall time — the reference's ``logger->total()``."""
+        elapsed = time.perf_counter() - self._t0
+        print(f"{msg} {elapsed:.6f} s", file=self.stream)
+
+
+class NullLogger(Logger):
+    """Silent logger for tests/library use."""
+
+    def __init__(self):
+        super().__init__(stream=None)
+
+    def begin(self) -> None:
+        pass
+
+    def phase(self, msg: str) -> None:
+        pass
+
+    def tick(self, msg: str) -> None:
+        pass
+
+    def total(self, msg: str) -> None:
+        pass

@@ -18,6 +18,11 @@ import pytest  # noqa: E402
 REFERENCE_DATA = "/root/reference/test/data"
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running end-to-end golden tests")
+
+
 def reference_data_path(name: str) -> str:
     return os.path.join(REFERENCE_DATA, name)
 

@@ -1,0 +1,114 @@
+"""Tests for the batched NW aligner: JAX device kernel, native C++ banded
+aligner, and their agreement with a plain numpy oracle."""
+
+import numpy as np
+import pytest
+
+from racon_tpu.ops.align import (DIAG, UP, LEFT, nw_align_batch, nw_scores,
+                                 nw_oracle, ops_to_cigar)
+from racon_tpu.native.aligner import NativeAligner
+
+
+def _score_of_ops(q, t, ops, m, x, g):
+    qi = ti = s = 0
+    for d in ops:
+        if d == DIAG:
+            s += m if q[qi] == t[ti] else x
+            qi += 1
+            ti += 1
+        elif d == UP:
+            s += g
+            qi += 1
+        else:
+            s += g
+            ti += 1
+    assert qi == len(q) and ti == len(t)
+    return s
+
+
+SCORINGS = [(5, -4, -8), (0, -1, -1), (1, -1, -1)]
+
+
+@pytest.mark.parametrize("scoring", SCORINGS)
+def test_jax_kernel_matches_oracle(scoring):
+    import jax.numpy as jnp
+    m, x, g = scoring
+    rng = np.random.default_rng(0)
+    B, Lq, Lt = 12, 48, 56
+    q = np.zeros((B, Lq), np.uint8)
+    t = np.zeros((B, Lt), np.uint8)
+    lq = rng.integers(1, Lq + 1, B).astype(np.int32)
+    lt = rng.integers(1, Lt + 1, B).astype(np.int32)
+    for b in range(B):
+        q[b, :lq[b]] = rng.integers(0, 5, lq[b])
+        t[b, :lt[b]] = rng.integers(0, 5, lt[b])
+    ops, n = nw_align_batch(jnp.asarray(q), jnp.asarray(t), jnp.asarray(lq),
+                            jnp.asarray(lt), match=m, mismatch=x, gap=g)
+    sc = nw_scores(jnp.asarray(q), jnp.asarray(t), jnp.asarray(lq),
+                   jnp.asarray(lt), match=m, mismatch=x, gap=g)
+    ops, n, sc = np.asarray(ops), np.asarray(n), np.asarray(sc)
+    W = ops.shape[1]
+    for b in range(B):
+        o = ops[b, W - n[b]:]
+        osc, oops = nw_oracle(q[b, :lq[b]], t[b, :lt[b]], m, x, g)
+        s = _score_of_ops(q[b, :lq[b]], t[b, :lt[b]], o, m, x, g)
+        assert s == osc == sc[b]
+        # identical tie-breaking -> identical path
+        assert np.array_equal(o, oops)
+
+
+@pytest.mark.parametrize("scoring", SCORINGS)
+def test_native_matches_oracle(scoring):
+    m, x, g = scoring
+    rng = np.random.default_rng(1)
+    al = NativeAligner(m, x, g)
+    for _ in range(60):
+        lq = int(rng.integers(1, 200))
+        lt = int(rng.integers(1, 200))
+        q = rng.integers(0, 5, lq).astype(np.uint8)
+        t = rng.integers(0, 5, lt).astype(np.uint8)
+        ops = al.align_codes(q, t)
+        osc, _ = nw_oracle(q, t, m, x, g)
+        assert _score_of_ops(q, t, ops, m, x, g) == osc
+
+
+def test_native_band_doubling_long_indel():
+    # Large length imbalance forces the adaptive band to grow.
+    rng = np.random.default_rng(2)
+    t = rng.integers(0, 4, 4000).astype(np.uint8)
+    q = np.concatenate([t[:1000], t[3000:]])  # 2000-base deletion
+    al = NativeAligner()
+    ops = al.align_codes(q, t)
+    osc, _ = nw_oracle(q, t, 0, -1, -1)
+    assert _score_of_ops(q, t, ops, 0, -1, -1) == osc == -2000
+
+
+def test_native_full_band_matches_jax_path():
+    import jax.numpy as jnp
+    rng = np.random.default_rng(3)
+    lq, lt = 90, 100
+    q = rng.integers(0, 4, lq).astype(np.uint8)
+    t = rng.integers(0, 4, lt).astype(np.uint8)
+    al = NativeAligner(5, -4, -8, band=10_000)  # full matrix
+    native_ops = al.align_codes(q, t)
+    ops, n = nw_align_batch(jnp.asarray(q[None]), jnp.asarray(t[None]),
+                            jnp.asarray([lq], np.int32),
+                            jnp.asarray([lt], np.int32),
+                            match=5, mismatch=-4, gap=-8)
+    jax_ops = np.asarray(ops)[0, ops.shape[1] - int(n[0]):]
+    assert np.array_equal(native_ops, jax_ops)
+
+
+def test_batch_api_empty_and_edge():
+    al = NativeAligner()
+    assert al.align_batch([]) == []
+    ops = al.align_codes(np.zeros(0, np.uint8), np.array([1, 2], np.uint8))
+    assert list(ops) == [LEFT, LEFT]
+    ops = al.align_codes(np.array([1, 2], np.uint8), np.zeros(0, np.uint8))
+    assert list(ops) == [UP, UP]
+
+
+def test_ops_to_cigar():
+    assert ops_to_cigar(np.array([], np.uint8)) == b""
+    assert ops_to_cigar(np.array([0, 0, 1, 2, 2, 0], np.uint8)) == \
+        b"2M1I2D1M"
