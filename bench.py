@@ -1,0 +1,107 @@
+"""Benchmark: POA window consensus throughput (windows/sec/chip).
+
+Prints exactly one JSON line on stdout:
+  {"metric": "...", "value": N, "unit": "...", "vs_baseline": N}
+
+Workload matches BASELINE.md's north-star metric: w=500-class windows at
+30x coverage (the reference's hot loop, src/polisher.cpp:451-513 ->
+src/window.cpp:61-137), run through the full PoaEngine pipeline — batched
+NW on device (or native host fallback), refinement rounds, and host column
+merge — i.e. the real end-to-end consensus cost per window, not just the
+kernel.
+
+Baseline: BASELINE.json targets >=20x a 64-thread CPU SPOA path. The
+reference publishes no absolute numbers, so the CPU anchor is estimated
+from the reference's own workload: single-thread racon polishes the
+bundled 96-window lambda dataset in tens of seconds (~2.5 windows/s);
+64 ideal threads ~= 160 windows/s. vs_baseline = value / 160, so
+vs_baseline >= 1.0 means at least estimated-64-thread-CPU parity and
+>= 20 hits the north-star target.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+CPU_64T_WINDOWS_PER_SEC = 160.0  # estimated 64-thread CPU SPOA anchor
+
+
+def build_windows(n_windows: int, coverage: int, wlen: int, seed: int = 0):
+    from racon_tpu.models.window import Window, WindowType
+    from racon_tpu.ops.encode import decode_bases
+
+    rng = np.random.default_rng(seed)
+    windows = []
+    for _ in range(n_windows):
+        true = rng.integers(0, 4, wlen).astype(np.uint8)
+
+        def noisy(rate=0.10):
+            keep = rng.random(wlen)
+            out = []
+            for b, r in zip(true, keep):
+                if r < rate / 3:
+                    continue
+                if r < 2 * rate / 3:
+                    out.append(int(rng.integers(0, 4)))
+                    continue
+                out.append(int(b))
+                if r < rate:
+                    out.append(int(rng.integers(0, 4)))
+            return decode_bases(np.asarray(out, np.uint8))
+
+        backbone = noisy()
+        qual = bytes(rng.integers(33 + 8, 33 + 25, len(backbone),
+                                  dtype=np.uint8))
+        w = Window(0, 0, WindowType.TGS, backbone, qual)
+        for _ in range(coverage):
+            lay = noisy()
+            lq = bytes(rng.integers(33 + 8, 33 + 25, len(lay),
+                                    dtype=np.uint8))
+            w.add_layer(lay, lq, 0, len(backbone) - 1)
+        windows.append(w)
+    return windows
+
+
+def main():
+    n_windows = int(sys.argv[1]) if len(sys.argv) > 1 else 96
+    coverage = int(sys.argv[2]) if len(sys.argv) > 2 else 30
+    wlen = 500
+
+    import jax
+    from racon_tpu.ops.poa import PoaEngine, _accelerator_present
+
+    backend = "jax" if _accelerator_present() else "native"
+    dev = jax.devices()[0].platform if backend == "jax" else "cpu-native"
+
+    # Warmup with the same workload shape so every bucketed kernel the
+    # measured run needs is already compiled.
+    eng = PoaEngine(backend=backend)
+    eng.consensus_windows(build_windows(n_windows, coverage, wlen, seed=99))
+
+    windows = build_windows(n_windows, coverage, wlen)
+    t0 = time.perf_counter()
+    eng = PoaEngine(backend=backend)
+    n_polished = eng.consensus_windows(windows)
+    dt = time.perf_counter() - t0
+    assert n_polished == n_windows
+
+    # Sanity: consensus must actually polish (each window was built from a
+    # 10%-error backbone; consensus should be near the truth, i.e. differ
+    # from the backbone).
+    n_changed = sum(1 for w in windows if w.consensus != bytes(w.backbone))
+    assert n_changed > n_windows * 0.9, "consensus did not polish"
+
+    value = n_windows / dt
+    print(json.dumps({
+        "metric": f"POA windows/sec/chip (w={wlen}, {coverage}x cov, "
+                  f"full engine incl. refinement, backend={backend}:{dev})",
+        "value": round(value, 2),
+        "unit": "windows/s",
+        "vs_baseline": round(value / CPU_64T_WINDOWS_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,116 @@
+"""Command-line interface, mirroring the reference ``racon`` CLI.
+
+Flags, defaults, help text, and output format follow the reference's
+getopt table and help() (src/main.cpp:14-160): polished sequences are
+emitted as FASTA on stdout, diagnostics go to stderr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from racon_tpu import __version__
+
+_USAGE = "racon_tpu [options ...] <sequences> <overlaps> <target sequences>"
+
+_DESCRIPTION = """\
+    <sequences>
+        input file in FASTA/FASTQ format (can be compressed with gzip)
+        containing sequences used for correction
+    <overlaps>
+        input file in MHAP/PAF/SAM format (can be compressed with gzip)
+        containing overlaps between sequences and target sequences
+    <target sequences>
+        input file in FASTA/FASTQ format (can be compressed with gzip)
+        containing sequences which will be corrected
+"""
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="racon_tpu", usage=_USAGE, description=_DESCRIPTION,
+        formatter_class=argparse.RawDescriptionHelpFormatter, add_help=False)
+    ap.add_argument("paths", nargs="*", metavar="<file>")
+    ap.add_argument("-u", "--include-unpolished", action="store_true",
+                    help="output unpolished target sequences")
+    ap.add_argument("-f", "--fragment-correction", action="store_true",
+                    help="perform fragment correction instead of contig "
+                         "polishing (overlaps file should contain dual/self "
+                         "overlaps!)")
+    ap.add_argument("-w", "--window-length", type=int, default=500,
+                    help="default: 500; size of window on which POA is "
+                         "performed")
+    ap.add_argument("-q", "--quality-threshold", type=float, default=10.0,
+                    help="default: 10.0; threshold for average base quality "
+                         "of windows used in POA")
+    ap.add_argument("-e", "--error-threshold", type=float, default=0.3,
+                    help="default: 0.3; maximum allowed error rate used for "
+                         "filtering overlaps")
+    ap.add_argument("-m", "--match", type=int, default=5,
+                    help="default: 5; score for matching bases")
+    ap.add_argument("-x", "--mismatch", type=int, default=-4,
+                    help="default: -4; score for mismatching bases")
+    ap.add_argument("-g", "--gap", type=int, default=-8,
+                    help="default: -8; gap penalty (must be negative)")
+    ap.add_argument("-t", "--threads", type=int, default=1,
+                    help="default: 1; kept for reference CLI compatibility "
+                         "(execution is batched on device/host instead of "
+                         "threaded)")
+    ap.add_argument("--backend", choices=["auto", "jax", "native"],
+                    default="auto",
+                    help="default: auto; alignment backend — 'jax' targets "
+                         "the TPU/accelerator, 'native' the C++ host "
+                         "aligner, 'auto' picks by available hardware")
+    ap.add_argument("--version", action="store_true",
+                    help="prints the version number")
+    ap.add_argument("-h", "--help", action="store_true",
+                    help="prints the usage")
+    return ap
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = build_parser()
+    args = ap.parse_args(argv)
+
+    if args.version:
+        print(f"v{__version__}")
+        return 0
+    if args.help:
+        ap.print_help()
+        return 0
+    if len(args.paths) < 3:
+        print("[racon_tpu::] error: missing input file(s)!", file=sys.stderr)
+        ap.print_help(sys.stderr)
+        return 1
+
+    from racon_tpu.models.overlap import PolisherError
+    from racon_tpu.io.parsers import ParseError
+    from racon_tpu.models.polisher import PolisherType, create_polisher
+    from racon_tpu.utils.logger import Logger
+
+    logger = Logger()
+    try:
+        polisher = create_polisher(
+            args.paths[0], args.paths[1], args.paths[2],
+            PolisherType.kF if args.fragment_correction else PolisherType.kC,
+            args.window_length, args.quality_threshold, args.error_threshold,
+            args.match, args.mismatch, args.gap, backend=args.backend,
+            logger=logger)
+        polisher.initialize()
+        polished = polisher.polish(not args.include_unpolished)
+    except (PolisherError, ParseError, ValueError) as exc:
+        print(str(exc), file=sys.stderr)
+        return 1
+
+    out = sys.stdout.buffer
+    for seq in polished:
+        out.write(b">" + seq.name.encode() + b"\n" + seq.data + b"\n")
+    out.flush()
+    logger.total("[racon_tpu::Polisher::] total =")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,8 +26,9 @@ from racon_tpu.models.window import Window, WindowType
 from racon_tpu.ops.poa import PoaEngine
 from racon_tpu.utils.logger import Logger, NullLogger
 
-# Streaming chunk size for reads/overlaps (src/polisher.cpp:22).
-CHUNK_SIZE = 1024 * 1024 * 1024
+# Streaming chunk size for reads/overlaps (src/polisher.cpp:22) — single
+# source of truth lives with the parsers.
+CHUNK_SIZE = iop.CHUNK_SIZE
 
 
 class PolisherType(enum.Enum):
@@ -228,9 +229,11 @@ class Polisher:
                 pairs.append((encode_bases(bytes(q)), encode_bases(bytes(t))))
             for o, ops in zip(pending, aligner.align_batch(pairs)):
                 o.cigar = ops_to_cigar(ops)
+        step = len(overlaps) // 20
         for i, o in enumerate(overlaps):
             o.find_breaking_points(self.sequences, self.window_length)
-            if len(overlaps) >= 20 and (i + 1) % (len(overlaps) // 20) == 0:
+            # 20-tick cap as in the reference (src/polisher.cpp:359-364).
+            if step and (i + 1) % step == 0 and (i + 1) // step <= 20:
                 log.tick("[racon_tpu::Polisher::initialize] aligning overlaps")
         log.phase("[racon_tpu::Polisher::initialize] aligned overlaps")
         log.begin()

@@ -5,7 +5,7 @@ holds raw (char*, len) pointers into Sequence storage and runs one SPOA
 graph per window on a CPU thread (src/window.hpp:61-67, window.cpp:61-137).
 Here a Window is a host-side descriptor holding zero-copy ``memoryview``
 slices; consensus is computed for *batches* of windows at once by the JAX
-engine (racon_tpu.ops.poa_jax), with windows as the batch dimension.
+engine (racon_tpu.ops.poa), with windows as the batch dimension.
 
 Parity points:
 - createWindow validates a non-empty backbone with equal-length quality
@@ -29,7 +29,6 @@ from typing import List, Optional
 import numpy as np
 
 from racon_tpu.models.overlap import PolisherError
-from racon_tpu.ops.encode import encode_bases
 
 
 class WindowType(enum.Enum):
@@ -122,82 +121,3 @@ def sorted_layer_order(window: Window) -> np.ndarray:
     (src/window.cpp:74-80). Stable to keep input order among ties."""
     return np.argsort(np.asarray(window.layer_begin, dtype=np.int64),
                       kind="stable")
-
-
-class WindowBatch:
-    """Padded device-ready arrays for a batch of same-bucket windows.
-
-    Layout (B = windows, C = max layers, L = max sequence length):
-      backbone   uint8[B, L]   base codes (0..4), zero-padded
-      backbone_w uint8[B, L]   per-base weights (phred-33, or 0 dummy —
-                               the reference feeds '!' dummy quality for
-                               targets without quality, src/polisher.cpp:141,383)
-      backbone_len int32[B]
-      layers     uint8[B, C, L]
-      layer_w    uint8[B, C, L] (phred-33 with quality, 1 without —
-                               SPOA default weight)
-      layer_len  int32[B, C]
-      layer_begin/end int32[B, C]  window-relative positions
-      n_layers   int32[B]
-    """
-
-    __slots__ = ("windows", "backbone", "backbone_w", "backbone_len",
-                 "layers", "layer_w", "layer_len", "layer_begin", "layer_end",
-                 "n_layers", "dropped_layers", "truncated_bases")
-
-    def __init__(self, windows: List[Window], max_layers: int, max_len: int,
-                 allow_truncate: bool = False):
-        B, C, L = len(windows), max_layers, max_len
-        # No silent caps: the reference consumes every layer in full
-        # (src/window.cpp:74-107), so caps below the batch maxima are an
-        # error unless the caller explicitly opts into truncation, in which
-        # case the damage is counted and queryable.
-        need_c = max((w.n_layers for w in windows), default=0)
-        need_l = max((max([len(w.backbone)] +
-                          [len(d) for d in w.layer_data])
-                      for w in windows), default=0)
-        if not allow_truncate and (need_c > C or need_l > L):
-            raise PolisherError(
-                f"[racon_tpu::WindowBatch] error: caps (layers={C}, len={L}) "
-                f"below batch maxima (layers={need_c}, len={need_l}); pass "
-                f"allow_truncate=True to accept degraded consensus")
-        self.dropped_layers = 0
-        self.truncated_bases = 0
-        self.windows = windows
-        self.backbone = np.zeros((B, L), dtype=np.uint8)
-        self.backbone_w = np.zeros((B, L), dtype=np.uint8)
-        self.backbone_len = np.zeros(B, dtype=np.int32)
-        self.layers = np.zeros((B, C, L), dtype=np.uint8)
-        self.layer_w = np.zeros((B, C, L), dtype=np.uint8)
-        self.layer_len = np.zeros((B, C), dtype=np.int32)
-        self.layer_begin = np.zeros((B, C), dtype=np.int32)
-        self.layer_end = np.zeros((B, C), dtype=np.int32)
-        self.n_layers = np.zeros(B, dtype=np.int32)
-
-        for b, w in enumerate(windows):
-            lb = min(len(w.backbone), L)
-            self.truncated_bases += len(w.backbone) - lb
-            self.backbone_len[b] = lb
-            self.backbone[b, :lb] = encode_bases(bytes(w.backbone[:lb]))
-            if w.backbone_quality is not None:
-                q = np.frombuffer(bytes(w.backbone_quality[:lb]),
-                                  dtype=np.uint8)
-                self.backbone_w[b, :lb] = q - 33
-            order = sorted_layer_order(w)
-            n = min(len(order), C)
-            self.n_layers[b] = n
-            self.dropped_layers += len(order) - n
-            for c, li in enumerate(order[:n]):
-                data = bytes(w.layer_data[li])
-                ll = min(len(data), L)
-                self.truncated_bases += len(data) - ll
-                self.layer_len[b, c] = ll
-                self.layers[b, c, :ll] = encode_bases(data[:ll])
-                qual = w.layer_quality[li]
-                if qual is None:
-                    self.layer_w[b, c, :ll] = 1
-                else:
-                    q = np.frombuffer(bytes(qual), dtype=np.uint8)[:ll]
-                    self.layer_w[b, c, :ll] = q - 33
-                self.layer_begin[b, c] = w.layer_begin[li]
-                self.layer_end[b, c] = w.layer_end[li]

@@ -67,33 +67,42 @@ BandResult band_pass(const uint8_t* q, int32_t lq, const uint8_t* t,
         if (jlo > jhi) return res;  // band fell off the matrix
         const uint8_t qc = q[i - 1];
         uint8_t* drow = dirs.data() + static_cast<size_t>(i) * bandw;
-        std::fill(cur.begin(), cur.end(), kNegInf);
 
         int32_t blo = jlo - i - klo;
-        int32_t bhi = jhi - i - klo;
-        // Vectorizable phase: tmp = max(diag, up).
-        for (int32_t b = blo; b <= bhi; ++b) {
-            const int32_t j = i + klo + b;
-            const int32_t sub = (j >= 1 && t[j - 1] == qc) ? m : x;
-            const int32_t diag = (j >= 1 ? prev[b] : kNegInf) + sub;
-            const int32_t up = prev[b + 1] + g;
-            cur[b] = diag > up ? diag : up;
+        const int32_t bhi = jhi - i - klo;
+        // j = 0 boundary handled outside the hot loops.
+        if (jlo == 0) {
+            cur[blo] = i * g;
+            drow[blo] = kUp;
+            ++blo;
         }
-        if (jlo == 0) cur[blo] = i * g;  // j = 0 boundary
+        // Branchless vectorizable phase: tmp = max(diag, up). For b in
+        // [blo, bhi], j = i + klo + b >= 1, so t[j-1] = tj[b] is in range.
+        const uint8_t* tj = t + (i + klo - 1);
+        const int32_t* pv = prev.data();
+        int32_t* cu = cur.data();
+        for (int32_t b = blo; b <= bhi; ++b) {
+            const int32_t sub = tj[b] == qc ? m : x;
+            const int32_t diag = pv[b] + sub;
+            const int32_t up = pv[b + 1] + g;
+            cu[b] = diag > up ? diag : up;
+        }
         // Serial phase: fold in the left-gap chain and label directions.
-        int32_t left = kNegInf;
+        int32_t left = (jlo == 0) ? cur[blo - 1] : kNegInf;
         for (int32_t b = blo; b <= bhi; ++b) {
-            const int32_t j = i + klo + b;
-            const int32_t sub = (j >= 1 && t[j - 1] == qc) ? m : x;
-            const int32_t diag = (j >= 1 ? prev[b] : kNegInf) + sub;
-            const int32_t up = prev[b + 1] + g;
-            int32_t h = cur[b];
+            const int32_t diag = pv[b] + (tj[b] == qc ? m : x);
+            int32_t h = cu[b];
             if (left + g > h) h = left + g;
-            if (j == 0) h = i * g;
-            cur[b] = h;
+            cu[b] = h;
             left = h;
-            drow[b] = (h == diag) ? kDiag : (h == up ? kUp : kLeft);
+            drow[b] = (h == diag) ? kDiag
+                                  : (h == pv[b + 1] + g ? kUp : kLeft);
         }
+        // Sentinels outside the valid window (the next row reads one slot
+        // past each side; a full fill per row is wasted bandwidth).
+        if (blo - 1 >= 0 && jlo != 0) cur[blo - 1] = kNegInf;
+        if (blo - 2 >= 0) cur[blo - 2] = kNegInf;
+        if (bhi + 1 < bandw + 1) cur[bhi + 1] = kNegInf;
         std::swap(prev, cur);
     }
 

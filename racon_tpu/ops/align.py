@@ -69,34 +69,38 @@ def _nw_dirs(q: jnp.ndarray, t: jnp.ndarray, match: int, mismatch: int,
     return dirs
 
 
+PAD_OP = 3  # emitted after the walk reaches (0, 0)
+
+
 def _traceback(dirs: jnp.ndarray, lq: jnp.ndarray, lt: jnp.ndarray):
     """Walk the direction matrix from (lq, lt) back to (0, 0).
 
-    Returns (ops, n_ops): ops uint8[Lq+Lt] holds the alignment operations
-    right-aligned (ops[L-n_ops:] is the path in start->end order).
+    A fixed-length ``lax.scan`` *emits* one op per step (end->start order,
+    PAD_OP once finished) instead of scattering into a buffer — scatters
+    serialize terribly on TPU, stacked scan outputs do not.
+
+    Returns (rev_ops, n_ops): rev_ops uint8[Lq+Lt] is the path reversed,
+    front-aligned, padded with PAD_OP.
     """
     Lq, Lt = dirs.shape
     L = Lq + Lt
 
-    def cond(state):
-        i, j, pos, _ = state
-        return (i > 0) | (j > 0)
-
-    def body(state):
-        i, j, pos, ops = state
-        d = jnp.where(i == 0, LEFT,
-                      jnp.where(j == 0, UP, dirs[i - 1, j - 1]))
+    def step(state, _):
+        i, j = state
+        done = (i == 0) & (j == 0)
+        d = jnp.where(done, PAD_OP,
+                      jnp.where(i == 0, LEFT,
+                                jnp.where(j == 0, UP,
+                                          dirs[jnp.maximum(i - 1, 0),
+                                               jnp.maximum(j - 1, 0)])))
         d = d.astype(jnp.uint8)
-        ops = ops.at[pos].set(d)
-        i = i - jnp.where(d != LEFT, 1, 0).astype(i.dtype)
-        j = j - jnp.where(d != UP, 1, 0).astype(j.dtype)
-        return i, j, pos - 1, ops
+        i = i - jnp.where((d == DIAG) | (d == UP), 1, 0).astype(i.dtype)
+        j = j - jnp.where((d == DIAG) | (d == LEFT), 1, 0).astype(j.dtype)
+        return (i, j), d
 
-    ops0 = jnp.zeros((L,), dtype=jnp.uint8)
-    i, j, pos, ops = jax.lax.while_loop(
-        cond, body, (lq.astype(jnp.int32), lt.astype(jnp.int32),
-                     jnp.int32(L - 1), ops0))
-    return ops, (jnp.int32(L - 1) - pos)
+    (_, _), rev_ops = jax.lax.scan(
+        step, (lq.astype(jnp.int32), lt.astype(jnp.int32)), None, length=L)
+    return rev_ops
 
 
 @functools.partial(jax.jit, static_argnames=("match", "mismatch", "gap"))
@@ -113,7 +117,11 @@ def nw_align_batch(q: jnp.ndarray, t: jnp.ndarray, lq: jnp.ndarray,
     """
     dirs = jax.vmap(
         lambda a, b: _nw_dirs(a, b, match, mismatch, gap))(q, t)
-    return jax.vmap(_traceback)(dirs, lq, lt)
+    rev = jax.vmap(_traceback)(dirs, lq, lt)
+    n = jnp.sum(rev != PAD_OP, axis=1).astype(jnp.int32)
+    # Flip to start->end order: right-aligned with PAD_OP in front, so
+    # ops[b, L - n[b]:] is the path (same contract as before).
+    return jnp.flip(rev, axis=1), n
 
 
 @functools.partial(jax.jit, static_argnames=("match", "mismatch", "gap"))

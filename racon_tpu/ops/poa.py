@@ -82,9 +82,9 @@ class PoaEngine:
     """
 
     def __init__(self, match: int = 5, mismatch: int = -4, gap: int = -8,
-                 backend: str = "auto", device_batch: int = 512,
+                 backend: str = "auto", device_batch: int = 4096,
                  refine_rounds: int = 3, ins_scale: float = 0.3,
-                 log=sys.stderr):
+                 mesh=None, log=sys.stderr):
         if gap >= 0:
             raise ValueError(
                 "[racon_tpu::PoaEngine] error: gap penalty must be negative!")
@@ -102,6 +102,9 @@ class PoaEngine:
         if backend == "auto":
             backend = "jax" if _accelerator_present() else "native"
         self.backend = backend
+        # Optional jax.sharding.Mesh: alignment batches shard over its
+        # "dp" axis (racon_tpu/parallel/dispatch.py).
+        self.mesh = mesh
         self._native = None
 
     # ------------------------------------------------------------ public API
@@ -158,11 +161,7 @@ class PoaEngine:
                 jobs.extend(self._build_jobs(wi, anchors[wi][0],
                                              layers[wi], spans[wi]))
             self._align(jobs)
-            by_win: List[List[_Job]] = [[] for _ in active]
-            for j in jobs:
-                by_win[j.win].append(j)
-            results = [self._merge(anchors[wi][0], anchors[wi][1], wjobs)
-                       for wi, wjobs in enumerate(by_win)]
+            results = self._merge_round(anchors, jobs)
             # Next round anchors: the fresh consensus with neutral weights
             # (reads re-vote from scratch); spans mapped through the merge.
             new_anchors = []
@@ -232,20 +231,30 @@ class PoaEngine:
             chunk = [jobs[i] for i in order[s:s + bs]]
             Lq = _round_up(max(len(j.q) for j in chunk))
             Lt = _round_up(max(j.t_len for j in chunk))
-            B = len(chunk)
+            # Pad the batch dimension onto a coarse grid (512, 1024, 2048,
+            # 3072, 4096) so chunks reuse a handful of compiled
+            # executables per (Lq, Lt) bucket without paying full-batch
+            # padding; padded rows are length-1 dummies.
+            B = 512 if len(chunk) <= 512 else _round_up(len(chunk), 1024)
             q = np.zeros((B, Lq), np.uint8)
             t = np.zeros((B, Lt), np.uint8)
-            lq = np.zeros(B, np.int32)
-            lt = np.zeros(B, np.int32)
+            lq = np.ones(B, np.int32)
+            lt = np.ones(B, np.int32)
             for b, j in enumerate(chunk):
                 lq[b] = len(j.q)
                 lt[b] = j.t_len
                 q[b, :lq[b]] = j.q
                 t[b, :lt[b]] = j.t
-            ops, n = nw_align_batch(
-                jnp.asarray(q), jnp.asarray(t), jnp.asarray(lq),
-                jnp.asarray(lt), match=self.match, mismatch=self.mismatch,
-                gap=self.gap)
+            if self.mesh is not None:
+                from racon_tpu.parallel.dispatch import nw_align_batch_sharded
+                ops, n = nw_align_batch_sharded(
+                    self.mesh, q, t, lq, lt, match=self.match,
+                    mismatch=self.mismatch, gap=self.gap)
+            else:
+                ops, n = nw_align_batch(
+                    jnp.asarray(q), jnp.asarray(t), jnp.asarray(lq),
+                    jnp.asarray(lt), match=self.match,
+                    mismatch=self.mismatch, gap=self.gap)
             ops = np.asarray(ops)
             n = np.asarray(n)
             W = ops.shape[1]
@@ -254,134 +263,223 @@ class PoaEngine:
 
     # ----------------------------------------------------------------- merge
 
-    def _merge(self, bb: np.ndarray, bb_w: np.ndarray, jobs: List[_Job]
-               ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
-        """Column-merge aligned jobs against the anchor ``bb``.
+    def _merge_round(self, anchors: List[Tuple[np.ndarray, np.ndarray]],
+                     jobs: List[_Job]
+                     ) -> List[Tuple[np.ndarray, np.ndarray, np.ndarray,
+                                     np.ndarray]]:
+        """Column-merge every aligned job of a round, all windows at once.
 
-        Returns (consensus_codes, coverage, map_b, map_e) where map_b[p] /
-        map_e[p] give, for every anchor position p, the consensus index of
-        the first kept column >= p / last kept column <= p — the
-        coordinate maps refinement rounds use to re-slice layer spans.
+        All scatter work runs as flat numpy adds over concatenated
+        per-window column/gap arrays (one ``np.add.at`` per vote class for
+        the whole round, instead of per-job Python loops) — the host-side
+        analogue of the device batching. Only multi-base insertion runs
+        (rare) take a Python path.
+
+        Returns per window (consensus_codes, coverage, map_b, map_e);
+        map_b[p] / map_e[p] give, for every anchor position p, the
+        consensus index of the first kept column >= p / last kept column
+        <= p — the coordinate maps refinement rounds use to re-slice
+        layer spans.
         """
-        L = len(bb)
-        base_w = np.zeros((L, ALPHABET), dtype=np.float64)
-        base_c = np.zeros((L, ALPHABET), dtype=np.int32)
-        del_w = np.zeros(L, dtype=np.float64)
-        # Gap g = insertion point before backbone position g (g in 0..L).
-        # direct_w[g] = weight of reads crossing g without inserting;
-        # ins[g] = left-justified pileup of inserted segments at g.
-        direct_w = np.zeros(L + 1, dtype=np.float64)
-        ins: Dict[int, "_InsPileup"] = {}
+        n_win = len(anchors)
+        Ls = np.array([len(bb) for bb, _ in anchors], dtype=np.int64)
+        col_off = np.concatenate([[0], np.cumsum(Ls)])
+        gap_off = np.concatenate([[0], np.cumsum(Ls + 1)])
+        total_c = int(col_off[-1])
+        total_g = int(gap_off[-1])
 
-        # Backbone is sequence 0 (src/window.cpp:34-37): epsilon keeps its
-        # base winning argmax ties at zero read coverage.
-        pos = np.arange(L)
-        base_w[pos, bb] += bb_w + _EPS
-        base_c[pos, bb] += 1
-        bb_cross = (np.concatenate([[bb_w[0]], bb_w]) +
-                    np.concatenate([bb_w, [bb_w[-1]]])) * 0.5
-        direct_w += bb_cross + _EPS
+        base_w = np.zeros(total_c * ALPHABET, dtype=np.float64)
+        base_c = np.zeros(total_c * ALPHABET, dtype=np.int64)
+        del_w = np.zeros(total_c, dtype=np.float64)
+        # Gap g of window w = insertion point before column g (g in 0..L).
+        direct_w = np.zeros(total_g, dtype=np.float64)
+        ins1_w = np.zeros(total_g * ALPHABET, dtype=np.float64)
+        ins1_c = np.zeros(total_g * ALPHABET, dtype=np.int64)
+        ins1_stop = np.zeros(total_g, dtype=np.float64)
+        piles: Dict[int, _InsPileup] = {}  # gaps with multi-base runs
 
-        for j in jobs:
-            o = j.ops
-            consumes_q = o != LEFT
-            consumes_t = o != UP
-            qpos = np.cumsum(consumes_q) - consumes_q  # q index per op
-            tpos = j.t_off + np.cumsum(consumes_t) - consumes_t
+        # Backbone votes (sequence 0, src/window.cpp:34-37): epsilon keeps
+        # the backbone base winning argmax ties at zero read coverage.
+        bb_flat = np.concatenate([bb for bb, _ in anchors])
+        bbw_flat = np.concatenate([w for _, w in anchors])
+        np.add.at(base_w, np.arange(total_c) * ALPHABET + bb_flat,
+                  bbw_flat + _EPS)
+        np.add.at(base_c, np.arange(total_c) * ALPHABET + bb_flat, 1)
+        for wi, (bb, bw) in enumerate(anchors):
+            cross = (np.concatenate([[bw[0]], bw]) +
+                     np.concatenate([bw, [bw[-1]]])) * 0.5
+            direct_w[gap_off[wi]:gap_off[wi + 1]] += cross + _EPS
 
-            m = o == DIAG
-            mq, mt = qpos[m], tpos[m]
-            np.add.at(base_w, (mt, j.q[mq]), j.w[mq])
-            np.add.at(base_c, (mt, j.q[mq]), 1)
+        if jobs:
+            self._scatter_jobs(jobs, col_off, gap_off, base_w, base_c,
+                               del_w, direct_w, ins1_w, ins1_c, ins1_stop,
+                               piles)
 
-            d = o == LEFT
-            if d.any():
-                np.add.at(del_w, tpos[d], j.w_read)
+        # Column votes, flat across all windows.
+        base_w2 = base_w.reshape(total_c, ALPHABET)
+        best_code = np.argmax(base_w2, axis=1)
+        ar_c = np.arange(total_c)
+        best_w = base_w2[ar_c, best_code]
+        kept_flat = del_w <= best_w
+        cov_flat = base_c.reshape(total_c, ALPHABET)[ar_c, best_code]
 
-            # Direct crossings, weighted by the *local* flanking base
-            # qualities: inserted/uncertain bases carry low Phred scores in
-            # long reads, so a gap's "no insertion here" evidence must be
-            # judged against quality in the same neighbourhood, not the
-            # read-global mean.
-            t_idx = np.flatnonzero(consumes_t)
-            if len(t_idx) > 1:
-                # qpos can reach len(q) on trailing deletions; clamp — those
-                # ops take the w_read branch anyway.
-                qp = np.minimum(qpos, len(j.w) - 1)
-                wq = np.where(o == DIAG, j.w[qp], j.w_read)
-                adj = np.diff(t_idx) == 1  # no I ops between -> crossed
-                g_cross = tpos[t_idx[1:]][adj]
-                w_cross = 0.5 * (wq[t_idx[:-1]][adj] + wq[t_idx[1:]][adj])
-                np.add.at(direct_w, g_cross, w_cross)
+        # Single-base insertion winners, flat across all gaps; gaps with
+        # multi-base runs are re-decided through their pileups below.
+        ins1_w2 = ins1_w.reshape(total_g, ALPHABET)
+        g_tot = ins1_w2.sum(axis=1)
+        g_arg = np.argmax(ins1_w2, axis=1)
+        emit1 = g_tot > direct_w * self.ins_scale
 
-            i_mask = o == UP
-            if i_mask.any():
-                flat = np.flatnonzero(i_mask)
-                run_starts = flat[np.concatenate(
-                    [[True], np.diff(flat) > 1])]
-                run_ends = flat[np.concatenate([np.diff(flat) > 1, [True]])]
-                for s, e in zip(run_starts, run_ends):
-                    g = int(tpos[s])
-                    qs, qe = int(qpos[s]), int(qpos[e])
-                    pile = ins.get(g)
-                    if pile is None:
-                        pile = ins[g] = _InsPileup()
-                    pile.add(j.q[qs:qe + 1], j.w[qs:qe + 1])
+        # Hand each window only its own piles (sorted keys + searchsorted,
+        # instead of scanning the round-global dict per window).
+        pile_keys = np.array(sorted(piles.keys()), dtype=np.int64)
+        pile_bounds = np.searchsorted(pile_keys, gap_off)
 
-        # Column votes.
-        best_code = np.argmax(base_w, axis=1)
-        best_w = base_w[pos, best_code]
-        kept = del_w <= best_w
-        cov = base_c[pos, best_code]
+        results = []
+        for wi in range(n_win):
+            c0, c1 = int(col_off[wi]), int(col_off[wi + 1])
+            g0, g1 = int(gap_off[wi]), int(gap_off[wi + 1])
+            L = c1 - c0
+            kept = kept_flat[c0:c1]
+            codes = best_code[c0:c1]
+            cov = cov_flat[c0:c1]
 
-        # Insertion columns: keep emitting while reads extending the
-        # insertion outweigh reads that have stopped (direct crossings plus
-        # shorter insertions).
-        ins_events: List[Tuple[int, np.ndarray, np.ndarray]] = []
-        ins_len_at = np.zeros(L + 1, dtype=np.int64)
-        for g, pile in ins.items():
-            seq, cnt = pile.consensus(direct_w[g] * self.ins_scale)
-            if len(seq):
-                ins_events.append((g, seq, cnt))
+            ins_events: List[Tuple[int, np.ndarray, np.ndarray]] = []
+            for g in np.flatnonzero(emit1[g0:g1]):
+                gg = g0 + int(g)
+                if gg in piles:
+                    continue  # full pileup decides below
+                ins_events.append((
+                    int(g),
+                    np.array([g_arg[gg]], dtype=np.uint8),
+                    np.array([ins1_c.reshape(total_g, ALPHABET)
+                              [gg, g_arg[gg]]], dtype=np.int64)))
+            for gg in pile_keys[pile_bounds[wi]:pile_bounds[wi + 1]]:
+                gg = int(gg)
+                pile = piles[gg]
+                seq, cnt = pile.consensus(
+                    float(direct_w[gg]) * self.ins_scale,
+                    ins1_w2[gg], ins1_c.reshape(total_g, ALPHABET)[gg],
+                    float(ins1_stop[gg]))
+                if len(seq):
+                    ins_events.append((gg - g0, seq, cnt))
+            ins_events.sort(key=lambda e: e[0])
+
+            # Assemble consensus + per-base coverage.
+            ins_len_at = np.zeros(L + 1, dtype=np.int64)
+            parts: List[np.ndarray] = []
+            covs: List[np.ndarray] = []
+            last = 0
+            for g, seq, cnt in ins_events:
                 ins_len_at[g] = len(seq)
-        ins_events.sort(key=lambda e: e[0])
+                sel = kept[last:g]
+                parts.append(codes[last:g][sel])
+                covs.append(cov[last:g][sel])
+                parts.append(seq)
+                covs.append(cnt)
+                last = g
+            sel = kept[last:]
+            parts.append(codes[last:][sel])
+            covs.append(cov[last:][sel])
+            consensus = np.concatenate(parts).astype(np.uint8)
+            coverage = np.concatenate(covs).astype(np.int32)
 
-        # Assemble consensus + per-base coverage.
-        parts: List[np.ndarray] = []
-        covs: List[np.ndarray] = []
-        last = 0
-        for g, seq, cnt in ins_events:
-            sel = kept[last:g]
-            parts.append(best_code[last:g][sel])
-            covs.append(cov[last:g][sel])
-            parts.append(seq)
-            covs.append(cnt)
-            last = g
-        sel = kept[last:]
-        parts.append(best_code[last:][sel])
-        covs.append(cov[last:][sel])
-        consensus = np.concatenate(parts).astype(np.uint8) if parts else \
-            np.zeros(0, np.uint8)
-        coverage = np.concatenate(covs).astype(np.int32) if covs else \
-            np.zeros(0, np.int32)
+            # Coordinate maps anchor->consensus for refinement re-slicing.
+            kept_excl = np.cumsum(kept) - kept      # kept columns before p
+            ins_before = np.cumsum(ins_len_at)[:L]  # inserted bases, g<=p
+            new_col = kept_excl + ins_before        # index where p landed
+            kept_idx = np.flatnonzero(kept)
+            ar = np.arange(L)
+            if len(kept_idx) == 0:
+                map_b = np.zeros(L, dtype=np.int64)
+                map_e = np.zeros(L, dtype=np.int64)
+            else:
+                nb = np.searchsorted(kept_idx, ar, side="left")
+                map_b = new_col[kept_idx[np.minimum(nb, len(kept_idx) - 1)]]
+                ne = np.searchsorted(kept_idx, ar, side="right") - 1
+                map_e = new_col[kept_idx[np.maximum(ne, 0)]]
+            np.clip(map_b, 0, max(len(consensus) - 1, 0), out=map_b)
+            np.clip(map_e, 0, max(len(consensus) - 1, 0), out=map_e)
+            results.append((consensus, coverage, map_b, map_e))
+        return results
 
-        # Coordinate maps anchor->consensus for refinement re-slicing.
-        kept_excl = np.cumsum(kept) - kept          # kept columns before p
-        ins_before = np.cumsum(ins_len_at)[:L]      # inserted bases at g<=p
-        new_col = kept_excl + ins_before            # index where p landed
-        kept_idx = np.flatnonzero(kept)
-        ar = np.arange(L)
-        if len(kept_idx) == 0:
-            map_b = np.zeros(L, dtype=np.int64)
-            map_e = np.zeros(L, dtype=np.int64)
-        else:
-            nb = np.searchsorted(kept_idx, ar, side="left")
-            map_b = new_col[kept_idx[np.minimum(nb, len(kept_idx) - 1)]]
-            ne = np.searchsorted(kept_idx, ar, side="right") - 1
-            map_e = new_col[kept_idx[np.maximum(ne, 0)]]
-        np.clip(map_b, 0, max(len(consensus) - 1, 0), out=map_b)
-        np.clip(map_e, 0, max(len(consensus) - 1, 0), out=map_e)
-        return consensus, coverage, map_b, map_e
+    def _scatter_jobs(self, jobs, col_off, gap_off, base_w, base_c, del_w,
+                      direct_w, ins1_w, ins1_c, ins1_stop, piles) -> None:
+        """Flat scatter of every job's votes into the round accumulators."""
+        lens = np.array([len(j.ops) for j in jobs], dtype=np.int64)
+        starts = np.concatenate([[0], np.cumsum(lens)[:-1]])
+        o = np.concatenate([j.ops for j in jobs])
+        q_flat = np.concatenate([j.q for j in jobs])
+        w_flat = np.concatenate([j.w for j in jobs]).astype(np.float64)
+        q_lens = np.array([len(j.q) for j in jobs], dtype=np.int64)
+        q_offs = np.concatenate([[0], np.cumsum(q_lens)[:-1]])
+
+        jid = np.repeat(np.arange(len(jobs)), lens)
+        w_read = np.repeat(np.array([j.w_read for j in jobs]), lens)
+        # Global column of each op's target position: window column offset
+        # + slice offset + within-slice t index (segmented cumsum).
+        wins = np.array([j.win for j in jobs], dtype=np.int64)
+        t_base = np.repeat(col_off[wins] + [j.t_off for j in jobs], lens)
+        g_base = np.repeat(gap_off[wins] + [j.t_off for j in jobs], lens)
+
+        cq = o != LEFT
+        ct = o != UP
+        c_cq = np.cumsum(cq)
+        c_ct = np.cumsum(ct)
+        pre_q = c_cq - cq
+        pre_t = c_ct - ct
+        qpos = pre_q - np.repeat(pre_q[starts], lens)  # q index within job
+        tpos = pre_t - np.repeat(pre_t[starts], lens)  # t index within slice
+        gq = np.minimum(q_offs[jid] + qpos, q_offs[jid] + q_lens[jid] - 1)
+        gcol = t_base + tpos
+        ggap = g_base + tpos
+
+        m = o == DIAG
+        np.add.at(base_w, gcol[m] * ALPHABET + q_flat[gq[m]], w_flat[gq[m]])
+        np.add.at(base_c, gcol[m] * ALPHABET + q_flat[gq[m]], 1)
+
+        d = o == LEFT
+        if d.any():
+            np.add.at(del_w, gcol[d], w_read[d])
+
+        # Direct crossings, weighted by the *local* flanking base
+        # qualities: inserted/uncertain bases carry low Phred scores in
+        # long reads, so a gap's "no insertion here" evidence is judged
+        # against quality in the same neighbourhood, not the read mean.
+        t_idx = np.flatnonzero(ct)
+        if len(t_idx) > 1:
+            wq = np.where(m, w_flat[gq], w_read)
+            same = jid[t_idx[1:]] == jid[t_idx[:-1]]
+            adj = (np.diff(t_idx) == 1) & same  # no I ops between
+            g_cross = ggap[t_idx[1:]][adj]
+            w_cross = 0.5 * (wq[t_idx[:-1]][adj] + wq[t_idx[1:]][adj])
+            np.add.at(direct_w, g_cross, w_cross)
+
+        i_mask = o == UP
+        if not i_mask.any():
+            return
+        flat = np.flatnonzero(i_mask)
+        brk = (np.diff(flat) > 1) | (jid[flat[1:]] != jid[flat[:-1]])
+        run_s = flat[np.concatenate([[True], brk])]
+        run_e = flat[np.concatenate([brk, [True]])]
+        run_len = run_e - run_s + 1
+        one = run_len == 1
+        # Single-base runs (the vast majority): fully vectorized.
+        s1 = run_s[one]
+        g1 = ggap[s1]
+        b1 = q_flat[gq[s1]]
+        w1 = w_flat[gq[s1]]
+        np.add.at(ins1_w, g1 * ALPHABET + b1, w1)
+        np.add.at(ins1_c, g1 * ALPHABET + b1, 1)
+        np.add.at(ins1_stop, g1, w1)
+        # Multi-base runs: per-run pileups (Python path, rare).
+        for s, e in zip(run_s[~one], run_e[~one]):
+            g = int(ggap[s])
+            qs, qe = int(gq[s]), int(gq[e])
+            pile = piles.get(g)
+            if pile is None:
+                pile = piles[g] = _InsPileup()
+            pile.add(q_flat[qs:qe + 1], w_flat[qs:qe + 1])
 
 
 class _InsPileup:
@@ -408,17 +506,32 @@ class _InsPileup:
             self.col_c[k][seg[k]] += 1
         self.len_w[len(seg)] = self.len_w.get(len(seg), 0.0) + float(w.mean())
 
-    def consensus(self, direct: float) -> Tuple[np.ndarray, np.ndarray]:
+    def consensus(self, direct: float, extra0_w=None, extra0_c=None,
+                  extra_stop1: float = 0.0
+                  ) -> Tuple[np.ndarray, np.ndarray]:
+        """Vote out the insertion columns.
+
+        extra0_w/extra0_c fold in single-base runs at the same gap that
+        were accumulated in the round's flat arrays; their weight joins
+        the stopped side after column 0 (extra_stop1).
+        """
         out: List[int] = []
         cnt: List[int] = []
         stopped = float(direct)
         for k in range(len(self.col_w)):
-            if self.col_w[k].sum() <= stopped:
+            cw = self.col_w[k]
+            cc = self.col_c[k]
+            if k == 0 and extra0_w is not None:
+                cw = cw + extra0_w
+                cc = cc + extra0_c
+            if cw.sum() <= stopped:
                 break
-            b = int(np.argmax(self.col_w[k]))
+            b = int(np.argmax(cw))
             out.append(b)
-            cnt.append(int(self.col_c[k][b]))
+            cnt.append(int(cc[b]))
             stopped += self.len_w.get(k + 1, 0.0)
+            if k == 0:
+                stopped += extra_stop1
         return (np.asarray(out, dtype=np.uint8),
                 np.asarray(cnt, dtype=np.int32))
 

@@ -1,0 +1,173 @@
+"""Multi-chip dispatch: the thread_pool replacement, TPU-style.
+
+The reference's only parallelism is a shared-memory thread pool over
+embarrassingly-parallel tasks (reference: src/polisher.cpp:143-155,
+341-364, 457-469). The TPU equivalents here:
+
+- **dp** (data parallel): alignment jobs (window, layer) are the batch
+  dimension, sharded across chips with a ``jax.sharding.Mesh`` +
+  ``NamedSharding``. Zero collectives — jobs are independent, exactly like
+  the reference's per-window futures; XLA partitions the vmapped DP scan
+  with no communication.
+- **sp** (sequence parallel): for windows longer than one chip's liking,
+  the NW target axis is sharded over chips. Each DP row step then needs a
+  one-column halo from the left neighbour (``ppermute``) and a global
+  max-prefix for the gap chain (``all_gather`` of block maxima) — the
+  long-context decomposition over ICI.
+- **hosts / DCN**: disjoint target chunks via racon_tpu.tools (rampler
+  split), no communication, matching the reference wrapper's sequential
+  chunking (scripts/racon_wrapper.py:125-135).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              axes: Tuple[str, ...] = ("dp",),
+              shape: Optional[Tuple[int, ...]] = None) -> Mesh:
+    """Build a device mesh over the first n available devices.
+
+    With one axis, all devices go to "dp". With two axes and no explicit
+    shape, devices split evenly with "sp" getting the smaller factor.
+    """
+    devs = jax.devices()
+    n = n_devices if n_devices is not None else len(devs)
+    if n > len(devs):
+        raise ValueError(
+            f"[racon_tpu::parallel] error: {n} devices requested, "
+            f"{len(devs)} available")
+    devs = devs[:n]
+    if shape is None:
+        if len(axes) == 1:
+            shape = (n,)
+        elif len(axes) == 2:
+            sp = 2 if n % 2 == 0 and n >= 2 else 1
+            shape = (n // sp, sp)
+        else:
+            raise ValueError("unsupported axes")
+    return Mesh(np.asarray(devs).reshape(shape), axes)
+
+
+def pad_batch(n: int, mult: int) -> int:
+    return ((n + mult - 1) // mult) * mult
+
+
+def shard_align_inputs(mesh: Mesh, q: np.ndarray, t: np.ndarray,
+                       lq: np.ndarray, lt: np.ndarray, axis: str = "dp"):
+    """Pad the batch to the dp size and place inputs sharded over chips.
+
+    Padded rows get length-1 dummies so traceback terminates instantly.
+    """
+    ndp = mesh.shape[axis]
+    B = q.shape[0]
+    Bp = pad_batch(B, ndp)
+    if Bp != B:
+        q = np.concatenate([q, np.zeros((Bp - B, q.shape[1]), q.dtype)])
+        t = np.concatenate([t, np.zeros((Bp - B, t.shape[1]), t.dtype)])
+        lq = np.concatenate([lq, np.ones(Bp - B, lq.dtype)])
+        lt = np.concatenate([lt, np.ones(Bp - B, lt.dtype)])
+    row = NamedSharding(mesh, P(axis, None))
+    vec = NamedSharding(mesh, P(axis))
+    return (jax.device_put(jnp.asarray(q), row),
+            jax.device_put(jnp.asarray(t), row),
+            jax.device_put(jnp.asarray(lq), vec),
+            jax.device_put(jnp.asarray(lt), vec), B)
+
+
+def nw_align_batch_sharded(mesh: Mesh, q: np.ndarray, t: np.ndarray,
+                           lq: np.ndarray, lt: np.ndarray, *, match: int,
+                           mismatch: int, gap: int):
+    """Data-parallel batched NW: jobs sharded over the mesh's dp axis.
+
+    Returns host numpy (ops, n_ops) trimmed back to the true batch size.
+    """
+    from racon_tpu.ops.align import nw_align_batch
+    qd, td, lqd, ltd, B = shard_align_inputs(mesh, q, t, lq, lt)
+    with mesh:
+        ops, n = nw_align_batch(qd, td, lqd, ltd, match=match,
+                                mismatch=mismatch, gap=gap)
+    return np.asarray(ops)[:B], np.asarray(n)[:B]
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("match", "mismatch", "gap", "mesh"))
+def _sp_scores_jit(q, t, lq, lt, *, match, mismatch, gap, mesh):
+    from jax.experimental.shard_map import shard_map
+
+    nsp = mesh.shape["sp"]
+    Lt = t.shape[1]
+    assert Lt % nsp == 0
+
+    def block(qb, tb, lqb, ltb):
+        # qb [b, Lq] replicated over sp; tb [b, Lt/nsp] — my target shard.
+        sp = jax.lax.axis_index("sp")
+        Ltl = tb.shape[1]
+        jglob = sp * Ltl + jnp.arange(1, Ltl + 1, dtype=jnp.int32)
+
+        def one(qv, tv, a, bcol):
+            row0 = jglob * gap
+            halo0 = (sp * Ltl) * gap  # H[0, first_j - 1]
+
+            def step(carry, inp):
+                prev, halo = carry
+                i, qi = inp
+                sub = jnp.where(tv == qi, match, mismatch).astype(jnp.int32)
+                prev_shift = jnp.concatenate([halo[None], prev[:-1]])
+                tmp = jnp.maximum(prev_shift + sub, prev + gap)
+                # Global gap-chain closure: local cummax + cross-chip
+                # prefix of block maxima + the j=0 boundary (i*gap).
+                f = tmp - jglob * gap
+                lmax = jax.lax.cummax(f)
+                blockmax = jax.lax.all_gather(lmax[-1], "sp")
+                idx = jnp.arange(nsp)
+                before = jnp.where(idx < sp, blockmax,
+                                   jnp.iinfo(jnp.int32).min // 2)
+                prefix = jnp.maximum(jnp.max(before), i * gap)
+                h = jnp.maximum(lmax, prefix) + jglob * gap
+                # Row frozen past the true query length so the final carry
+                # holds row lq.
+                h = jnp.where(i <= a, h, prev)
+                # Halo for the next row: my last column -> right neighbour.
+                nh = jax.lax.ppermute(
+                    h[-1], "sp", [(k, k + 1) for k in range(nsp - 1)])
+                nh = jnp.where(sp == 0, i * gap, nh)
+                nh = jnp.where(i <= a, nh, halo)
+                return (h, nh), None
+
+            ii = jnp.arange(1, qv.shape[0] + 1, dtype=jnp.int32)
+            # The scan body outputs are dp-varying (they read qv/tv), so
+            # the initial carry must carry the same varying-axes type.
+            carry0 = (jax.lax.pvary(row0, ("dp",)),
+                      jax.lax.pvary(jnp.int32(halo0), ("dp",)))
+            (final, _), _ = jax.lax.scan(
+                step, carry0, (ii, qv.astype(jnp.int32)))
+            # Score H[lq, lt] lives on the chip owning global column lt.
+            mine = jnp.sum(jnp.where(jglob == bcol, final, 0))
+            return jax.lax.psum(mine, "sp")
+
+        return jax.vmap(one)(qb, tb, lqb, ltb)
+
+    fn = shard_map(block, mesh=mesh,
+                   in_specs=(P("dp", None), P("dp", "sp"), P("dp"), P("dp")),
+                   out_specs=P("dp"))
+    return fn(q, t, lq, lt)
+
+
+def sp_nw_scores(mesh: Mesh, q: np.ndarray, t: np.ndarray, lq: np.ndarray,
+                 lt: np.ndarray, *, match: int, mismatch: int, gap: int):
+    """Sequence-parallel NW scores: target axis sharded over the "sp"
+    mesh axis, batch over "dp". Semantically identical to
+    racon_tpu.ops.align.nw_scores."""
+    qd, td, lqd, ltd, B = shard_align_inputs(mesh, q, t, lq, lt)
+    out = _sp_scores_jit(qd, td, lqd, ltd, match=match, mismatch=mismatch,
+                         gap=gap, mesh=mesh)
+    return np.asarray(out)[:B]

@@ -27,6 +27,11 @@ class Logger:
 
     def phase(self, msg: str) -> None:
         """Print elapsed phase time — the reference's ``(*logger)("msg")``."""
+        if self._bar:
+            # Close a partially drawn progress bar so this line starts
+            # fresh instead of appending to the '\r' bar.
+            print(file=self.stream)
+            self._bar = 0
         elapsed = time.perf_counter() - self._phase_t0
         print(f"{msg} {elapsed:.6f} s", file=self.stream)
 

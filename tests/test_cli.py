@@ -1,0 +1,55 @@
+"""CLI behavior tests (reference contract: src/main.cpp:14-160)."""
+
+import subprocess
+import sys
+
+import pytest
+
+
+def _run(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "racon_tpu.cli", *args],
+        capture_output=True, cwd="/root/repo")
+
+
+def test_version():
+    r = _run("--version")
+    assert r.returncode == 0
+    assert r.stdout.decode().startswith("v0.")
+
+
+def test_help():
+    r = _run("-h")
+    assert r.returncode == 0
+    out = r.stdout.decode()
+    for flag in ("--include-unpolished", "--fragment-correction",
+                 "--window-length", "--quality-threshold",
+                 "--error-threshold", "--match", "--mismatch", "--gap",
+                 "--threads"):
+        assert flag in out
+
+
+def test_missing_inputs():
+    r = _run()
+    assert r.returncode == 1
+    assert b"error: missing input file(s)!" in r.stderr
+
+
+def test_bad_extension():
+    r = _run("a.txt", "b.txt", "c.txt")
+    assert r.returncode == 1
+    assert b"unsupported format extension" in r.stderr
+
+
+@pytest.mark.slow
+def test_cli_polishes_to_stdout(ref_data):
+    r = _run("--backend", "native",
+             ref_data("sample_reads.fastq.gz"),
+             ref_data("sample_overlaps.sam.gz"),
+             ref_data("sample_layout.fasta.gz"))
+    assert r.returncode == 0
+    lines = r.stdout.split(b"\n")
+    assert lines[0].startswith(b">utg000001l LN:i:")
+    assert b" RC:i:181 " in lines[0]
+    assert len(lines[1]) > 40_000
+    assert b"total =" in r.stderr
