@@ -72,35 +72,37 @@ def _nw_dirs(q: jnp.ndarray, t: jnp.ndarray, match: int, mismatch: int,
 PAD_OP = 3  # emitted after the walk reaches (0, 0)
 
 
-def _traceback(dirs: jnp.ndarray, lq: jnp.ndarray, lt: jnp.ndarray):
-    """Walk the direction matrix from (lq, lt) back to (0, 0).
+def _traceback_flat(d1: jnp.ndarray, row_stride: int, b_off: jnp.ndarray,
+                    L: int, lq: jnp.ndarray, lt: jnp.ndarray):
+    """Walk all direction matrices from (lq, lt) back to (0, 0) at once.
 
-    A fixed-length ``lax.scan`` *emits* one op per step (end->start order,
-    PAD_OP once finished) instead of scattering into a buffer — scatters
-    serialize terribly on TPU, stacked scan outputs do not.
+    One fixed-length ``lax.scan`` over the whole batch *emits* one op per
+    lane per step (end->start order, PAD_OP once finished): no scatters
+    (they serialize terribly on TPU), and the per-step gather is a single
+    flat 1-D take. ``d1`` is the flattened direction tensor; a cell
+    (b, i, j) lives at ``(i-1)*row_stride + b_off[b] + (j-1)`` — this
+    covers both the [B, Lq, Lt] (XLA) and [Lq, B, Lt] (Pallas) layouts.
 
-    Returns (rev_ops, n_ops): rev_ops uint8[Lq+Lt] is the path reversed,
-    front-aligned, padded with PAD_OP.
+    Returns rev_ops uint8[B, L]: paths reversed, front-aligned, padded
+    with PAD_OP.
     """
-    Lq, Lt = dirs.shape
-    L = Lq + Lt
 
     def step(state, _):
         i, j = state
         done = (i == 0) & (j == 0)
+        idx = (jnp.maximum(i - 1, 0) * row_stride + b_off
+               + jnp.maximum(j - 1, 0))
+        dv = jnp.take(d1, idx)
         d = jnp.where(done, PAD_OP,
                       jnp.where(i == 0, LEFT,
-                                jnp.where(j == 0, UP,
-                                          dirs[jnp.maximum(i - 1, 0),
-                                               jnp.maximum(j - 1, 0)])))
-        d = d.astype(jnp.uint8)
+                                jnp.where(j == 0, UP, dv))).astype(jnp.uint8)
         i = i - jnp.where((d == DIAG) | (d == UP), 1, 0).astype(i.dtype)
         j = j - jnp.where((d == DIAG) | (d == LEFT), 1, 0).astype(j.dtype)
         return (i, j), d
 
     (_, _), rev_ops = jax.lax.scan(
         step, (lq.astype(jnp.int32), lt.astype(jnp.int32)), None, length=L)
-    return rev_ops
+    return rev_ops.T
 
 
 @functools.partial(jax.jit, static_argnames=("match", "mismatch", "gap"))
@@ -115,13 +117,58 @@ def nw_align_batch(q: jnp.ndarray, t: jnp.ndarray, lq: jnp.ndarray,
     Returns:
       ops uint8[B, Lq+Lt] (right-aligned per row), n_ops int32[B].
     """
+    B, Lq = q.shape
+    Lt = t.shape[1]
     dirs = jax.vmap(
         lambda a, b: _nw_dirs(a, b, match, mismatch, gap))(q, t)
-    rev = jax.vmap(_traceback)(dirs, lq, lt)
+    rev = _traceback_flat(dirs.reshape(-1), Lt,
+                          jnp.arange(B, dtype=jnp.int32) * (Lq * Lt),
+                          Lq + Lt, lq, lt)
     n = jnp.sum(rev != PAD_OP, axis=1).astype(jnp.int32)
     # Flip to start->end order: right-aligned with PAD_OP in front, so
     # ops[b, L - n[b]:] is the path (same contract as before).
     return jnp.flip(rev, axis=1), n
+
+
+@functools.partial(jax.jit, static_argnames=("match", "mismatch", "gap"))
+def _nw_align_batch_pallas(q, t, lq, lt, *, match, mismatch, gap):
+    """Pallas-forward variant of nw_align_batch (TPU; same contract)."""
+    from racon_tpu.ops.pallas.nw_kernel import nw_dirs_pallas
+    B, Lq = q.shape
+    Lt = t.shape[1]
+    dirs = nw_dirs_pallas(q, t, match=match, mismatch=mismatch, gap=gap)
+    rev = _traceback_flat(dirs.reshape(-1), B * Lt,
+                          jnp.arange(B, dtype=jnp.int32) * Lt,
+                          Lq + Lt, lq, lt)
+    n = jnp.sum(rev != PAD_OP, axis=1).astype(jnp.int32)
+    return jnp.flip(rev, axis=1), n
+
+
+def pallas_shapes_ok(B: int, Lq: int, Lt: int, match: int,
+                     mismatch: int) -> bool:
+    from racon_tpu.ops.pallas.nw_kernel import TB, CH
+    if not (B % TB == 0 and Lq % CH == 0 and Lt % 128 == 0):
+        return False
+    # The substitution matrix rides VMEM as int8 (scores must fit) and
+    # the pipelined in+out blocks plus the row scratch must stay under
+    # the ~16 MiB core VMEM: 2*(CH*TB*Lt * 2 bytes) + TB*Lt*4.
+    if not (-128 <= match <= 127 and -128 <= mismatch <= 127):
+        return False
+    vmem = 4 * CH * TB * Lt + 4 * TB * Lt
+    return vmem <= 12 * 1024 * 1024
+
+
+def nw_align_auto(q, t, lq, lt, *, match, mismatch, gap):
+    """Batched alignment choosing the Pallas kernel on TPU when shapes
+    allow, the pure-XLA path otherwise. Results are bit-identical."""
+    import jax as _jax
+    B, Lq = q.shape
+    Lt = t.shape[1]
+    use_pallas = (_jax.default_backend() in ("tpu", "axon")
+                  and pallas_shapes_ok(B, Lq, Lt, match, mismatch))
+    fn = _nw_align_batch_pallas if use_pallas else nw_align_batch
+    return fn(jnp.asarray(q), jnp.asarray(t), jnp.asarray(lq),
+              jnp.asarray(lt), match=match, mismatch=mismatch, gap=gap)
 
 
 @functools.partial(jax.jit, static_argnames=("match", "mismatch", "gap"))

@@ -1,0 +1,107 @@
+"""Pallas TPU kernel for the batched NW direction-matrix forward pass.
+
+The pure-XLA forward (racon_tpu/ops/align.py::_nw_dirs) is a lax.scan
+whose per-row step only touches [B, Lt] elements — far too little work to
+amortize per-step overhead. This kernel restructures the DP:
+
+- a tile of TB=128 alignments rides the *sublane* dimension, the target
+  axis rides the lanes, so each row update is a [128, Lt] register-tiled
+  VPU op — 16x the width of the 8-sublane naive layout;
+- the grid is (B/TB, Lq/CH): query rows are processed CH at a time from a
+  VMEM-resident block while the row state H[i-1, :] persists in a VMEM
+  scratch across grid steps (sequential "arbitrary" grid semantics);
+- the gap-chain closure is the max-plus prefix trick as log2(Lt)
+  shift-max steps;
+- dynamic indexing only ever touches the leading (untiled) dimension —
+  a Mosaic requirement — hence the [rows, TB, Lt] block layouts, with
+  the substitution matrix precomputed in XLA as a fused broadcast-compare
+  (int8, to keep pipelined VMEM blocks in budget).
+
+Semantics are bit-identical to _nw_dirs (same boundaries, same
+DIAG > UP > LEFT tie-breaking) — asserted by tests/test_align.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from racon_tpu.ops.cigar import DIAG, UP, LEFT
+
+_NEG = -(2 ** 30)
+TB = 128  # alignments per grid program (sublane width of each row op)
+CH = 32   # query rows per grid step
+
+
+def _kernel(sub_ref, dirs_ref, prev_ref, *, gap, Lt):
+    c = pl.program_id(1)
+    jr = jax.lax.broadcasted_iota(jnp.int32, (TB, Lt), 1) + 1
+    jg = jr * gap
+
+    @pl.when(c == 0)
+    def _():
+        prev_ref[:] = jg  # H[0, j] = j * gap
+
+    shifts = []
+    k = 1
+    while k < Lt:
+        shifts.append(k)
+        k *= 2
+
+    def row(r, _):
+        i = c * CH + r + 1  # global row number
+        sub = sub_ref[r].astype(jnp.int32)              # [TB, Lt]
+        prev = prev_ref[:]
+        prev_shift = jnp.concatenate(
+            [jnp.full((TB, 1), 0, jnp.int32) + (i - 1) * gap,
+             prev[:, :-1]], axis=1)
+        diag = prev_shift + sub
+        up = prev + gap
+        f = jnp.maximum(diag, up) - jg
+        for s in shifts:
+            f = jnp.maximum(
+                f, jnp.concatenate(
+                    [jnp.full((TB, s), _NEG, jnp.int32), f[:, :-s]],
+                    axis=1))
+        h = jnp.maximum(f, i * gap) + jg
+        d = jnp.where(h == diag, DIAG,
+                      jnp.where(h == up, UP, LEFT)).astype(jnp.uint8)
+        dirs_ref[r] = d
+        prev_ref[:] = h
+        return 0
+
+    jax.lax.fori_loop(0, CH, row, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("match", "mismatch", "gap"))
+def nw_dirs_pallas(q: jnp.ndarray, t: jnp.ndarray, *, match: int,
+                   mismatch: int, gap: int) -> jnp.ndarray:
+    """Direction matrices uint8[Lq, B, Lt] for a padded batch.
+
+    B must be a multiple of TB (128), Lq of CH (32), Lt of 128. Note the
+    rows-leading layout — the traceback consumes it directly.
+    """
+    B, Lq = q.shape
+    Lt = t.shape[1]
+    # Fused broadcast-compare in XLA: sub[i, b, j] = score(q[b,i], t[b,j]).
+    sub = jnp.where(q.T[:, :, None] == t[None, :, :], match,
+                    mismatch).astype(jnp.int8)
+    kernel = functools.partial(_kernel, gap=gap, Lt=Lt)
+    return pl.pallas_call(
+        kernel,
+        grid=(B // TB, Lq // CH),
+        in_specs=[
+            pl.BlockSpec((CH, TB, Lt), lambda b, c: (c, b, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((CH, TB, Lt), lambda b, c: (c, b, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((Lq, B, Lt), jnp.uint8),
+        scratch_shapes=[pltpu.VMEM((TB, Lt), jnp.int32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")),
+    )(sub)

@@ -251,9 +251,9 @@ class PoaEngine:
                     self.mesh, q, t, lq, lt, match=self.match,
                     mismatch=self.mismatch, gap=self.gap)
             else:
-                ops, n = nw_align_batch(
-                    jnp.asarray(q), jnp.asarray(t), jnp.asarray(lq),
-                    jnp.asarray(lt), match=self.match,
+                from racon_tpu.ops.align import nw_align_auto
+                ops, n = nw_align_auto(
+                    q, t, lq, lt, match=self.match,
                     mismatch=self.mismatch, gap=self.gap)
             ops = np.asarray(ops)
             n = np.asarray(n)

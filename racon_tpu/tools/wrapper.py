@@ -1,0 +1,122 @@
+"""racon_wrapper equivalent: subsample reads / split targets / polish
+per chunk.
+
+Mirrors scripts/racon_wrapper.py:53-135: optionally subsample the reads
+(rampler subsample), optionally split the targets into byte-bounded
+chunks (rampler split), then polish each chunk **sequentially** with
+identical options, streaming the combined FASTA to stdout.
+
+The target-chunk granularity is the framework's memory-bounding AND
+checkpoint/resume unit (the reference has no checkpointing; its wrapper's
+sequential chunks are the de-facto restart point — SURVEY.md §5). Here
+each chunk's output is written to ``<workdir>/chunk_<i>.fasta`` first and
+``--resume`` skips chunks whose output already exists, so an interrupted
+genome-scale run continues where it stopped. On multi-host deployments
+each host takes a disjoint slice of chunks (``--num-shards``/
+``--shard-id``) — no cross-host communication is needed, exactly like
+the reference's process-per-chunk model over DCN.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import sys
+import time
+from typing import List, Optional
+
+from racon_tpu.tools import rampler
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(prog="racon_tpu_wrapper")
+    ap.add_argument("sequences")
+    ap.add_argument("overlaps")
+    ap.add_argument("target_sequences")
+    ap.add_argument("--split", type=int, metavar="CHUNK_SIZE",
+                    help="split target sequences into chunks of the given "
+                         "size in bytes")
+    ap.add_argument("--subsample", type=int, nargs=2,
+                    metavar=("REF_LEN", "COVERAGE"),
+                    help="subsample sequences to the given coverage of the "
+                         "given reference length")
+    ap.add_argument("--work-directory", default=None)
+    ap.add_argument("--resume", action="store_true",
+                    help="reuse chunk outputs already present in the work "
+                         "directory")
+    ap.add_argument("--num-shards", type=int, default=1,
+                    help="total hosts polishing disjoint chunk slices")
+    ap.add_argument("--shard-id", type=int, default=0)
+    # polishing options forwarded to the Polisher (reference wrapper
+    # forwards the same set, scripts/racon_wrapper.py:150-180).
+    ap.add_argument("-u", "--include-unpolished", action="store_true")
+    ap.add_argument("-f", "--fragment-correction", action="store_true")
+    ap.add_argument("-w", "--window-length", type=int, default=500)
+    ap.add_argument("-q", "--quality-threshold", type=float, default=10.0)
+    ap.add_argument("-e", "--error-threshold", type=float, default=0.3)
+    ap.add_argument("-m", "--match", type=int, default=5)
+    ap.add_argument("-x", "--mismatch", type=int, default=-4)
+    ap.add_argument("-g", "--gap", type=int, default=-8)
+    ap.add_argument("-t", "--threads", type=int, default=1)
+    ap.add_argument("--backend", default="auto")
+    return ap
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    from racon_tpu.io.parsers import ParseError
+    from racon_tpu.models.overlap import PolisherError
+    from racon_tpu.models.polisher import PolisherType, create_polisher
+
+    work = args.work_directory or f"racon_tpu_work_directory_{int(time.time())}"
+    own_workdir = args.work_directory is None
+    os.makedirs(work, exist_ok=True)
+    try:
+        sequences = args.sequences
+        if args.subsample:
+            sequences = rampler.subsample(
+                sequences, args.subsample[0], args.subsample[1], work)
+
+        if args.split:
+            targets = rampler.split(args.target_sequences, args.split, work)
+        else:
+            targets = [args.target_sequences]
+
+        my_chunks = [(i, t) for i, t in enumerate(targets)
+                     if i % args.num_shards == args.shard_id]
+
+        out = sys.stdout.buffer
+        for i, target in my_chunks:
+            chunk_out = os.path.join(work, f"chunk_{i}.fasta")
+            if not (args.resume and os.path.isfile(chunk_out)):
+                polisher = create_polisher(
+                    sequences, args.overlaps, target,
+                    PolisherType.kF if args.fragment_correction
+                    else PolisherType.kC,
+                    args.window_length, args.quality_threshold,
+                    args.error_threshold, args.match, args.mismatch,
+                    args.gap, backend=args.backend)
+                polisher.initialize()
+                polished = polisher.polish(not args.include_unpolished)
+                tmp = chunk_out + ".tmp"
+                with open(tmp, "wb") as f:
+                    for seq in polished:
+                        f.write(b">" + seq.name.encode() + b"\n" +
+                                seq.data + b"\n")
+                os.replace(tmp, chunk_out)  # atomic checkpoint
+            with open(chunk_out, "rb") as f:
+                shutil.copyfileobj(f, out)
+        out.flush()
+    except (PolisherError, ParseError, ValueError) as exc:
+        print(str(exc), file=sys.stderr)
+        return 1
+    finally:
+        if own_workdir:
+            shutil.rmtree(work, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
