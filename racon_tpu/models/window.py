@@ -121,3 +121,34 @@ def sorted_layer_order(window: Window) -> np.ndarray:
     (src/window.cpp:74-80). Stable to keep input order among ties."""
     return np.argsort(np.asarray(window.layer_begin, dtype=np.int64),
                       kind="stable")
+
+
+def window_arrays(window: Window):
+    """Encode one window for a consensus engine (host or device).
+
+    Returns (layers, bb_codes, bb_weights): layers is a list of
+    (codes uint8, weights float32, begin, end) in processing order;
+    weights are Phred (quality - 33) or 1.0 without quality, the backbone
+    carries its quality or zeros (the reference's dummy '!' quality,
+    src/polisher.cpp:141).
+    """
+    from racon_tpu.ops.encode import encode_bases
+    layers = []
+    for li in sorted_layer_order(window):
+        data = bytes(window.layer_data[li])
+        qual = window.layer_quality[li]
+        codes = encode_bases(data)
+        if qual is not None:
+            wts = (np.frombuffer(bytes(qual), dtype=np.uint8)
+                   .astype(np.float32) - 33.0)
+        else:
+            wts = np.ones(len(data), dtype=np.float32)
+        layers.append((codes, wts, int(window.layer_begin[li]),
+                       int(window.layer_end[li])))
+    bb = encode_bases(bytes(window.backbone))
+    if window.backbone_quality is not None:
+        bw = (np.frombuffer(bytes(window.backbone_quality), dtype=np.uint8)
+              .astype(np.float32) - 33.0)
+    else:
+        bw = np.zeros(len(bb), dtype=np.float32)
+    return layers, bb, bw

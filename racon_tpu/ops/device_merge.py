@@ -1,0 +1,358 @@
+"""Device-resident consensus merge — the TPU replacement for the host
+column-vote (racon_tpu/ops/poa.py::_merge_round/_scatter_jobs/_InsPileup).
+
+Same semantics as the numpy reference implementation (which mirrors
+spoa's add_alignment + generate_consensus, reference src/window.cpp:
+100-111), restructured for TPU execution:
+
+- **No scatters.** XLA lowers general scatter-adds on TPU to serialized
+  updates; every per-op scatter in the numpy merge is reformulated as a
+  gather. The key identity: in a global alignment, ops with the same
+  "target positions consumed so far" value form one contiguous block
+  ``[insertion run at gap v][the op consuming column v]``, so a per-lane
+  ``searchsorted`` over that monotone counter finds, for every anchor
+  column, the op that consumed it and the insertion run before it — all
+  columns in parallel.
+- **Aggregation is a matmul.** Per-job dense per-column vote channels are
+  summed into per-window accumulators by a window-membership one-hot
+  matrix ([Nw, B] @ [B, LA*C]) on the MXU — weights are integer-valued
+  (Phred or 1.0), so f32 accumulation is exact below 2^24.
+- **Variable-length output without host round-trips.** Emitted consensus
+  lives in a padded [Nw, LA+1, K+1] slot layout (K insertion slots per
+  gap + the column slot); compaction to dense per-window strings is a
+  searchsorted gather over the valid-slot cumsum. Only the final compact
+  consensus + coverage leave the device.
+
+Deviations from the numpy reference (documented, covered by tolerance in
+differential tests): insertion pileups cap at K columns per gap (the
+reference is unbounded; >K-base unanimous insertions are truncated), and
+accumulator dtype is f32 (reference f64) so sub-ulp tie-breaks can differ
+when non-integer mean weights collide exactly.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from racon_tpu.ops.cigar import DIAG, UP, LEFT
+from racon_tpu.ops.flat import PAD_OP  # shared op padding marker
+from racon_tpu.ops.poa import _EPS as EPS  # shared tie-break epsilon
+
+K_INS = 8          # pileup columns per gap kept on device
+NBASE = 5          # A C G T N
+_HI = jnp.int32(2 ** 30)
+
+_PREC = jax.lax.Precision.HIGHEST
+
+
+def _onehot(idx, depth):
+    return (idx[..., None] == jnp.arange(depth, dtype=idx.dtype)).astype(
+        jnp.float32)
+
+
+def _take1(a, idx):
+    """take_along_axis on axis 1 with clipping."""
+    return jnp.take_along_axis(a, jnp.clip(idx, 0, a.shape[1] - 1), axis=1)
+
+
+def extract_votes(ops, q, qw, w_read, lt, t_off, LA: int):
+    """Per-job anchor-aligned dense vote channels from right-aligned ops.
+
+    Args:
+      ops:    uint8[B, S] right-aligned (PAD_OP prefix), start->end order.
+      q:      uint8[B, Lq] query codes.
+      qw:     f32[B, Lq] per-base weights.
+      w_read: f32[B] read-mean weight.
+      lt:     int32[B] target (slice) lengths.
+      t_off:  int32[B] slice offset in the window anchor.
+      LA:     static anchor padding length.
+
+    Returns dict of [B, LA(+1), ...] channel arrays (see code).
+    """
+    B, S = ops.shape
+    valid = ops != PAD_OP
+    tcons = valid & (ops != UP)
+    qcons = valid & (ops != LEFT)
+    ct = jnp.cumsum(tcons, axis=1, dtype=jnp.int32)
+    cq = jnp.cumsum(qcons, axis=1, dtype=jnp.int32)
+    ct_excl = ct - tcons
+    cq_excl = cq - qcons
+    # Monotone block key: pads (a prefix) sort below every real op.
+    X = jnp.where(valid, ct_excl, -1)
+
+    # F[v] = first op index of block v, for v = p - t_off at every anchor
+    # gap/column p in [0, LA]. (+1 row for F[v+1].) searchsorted-left over
+    # a monotone key == count of keys < v; the fused compare-reduce is
+    # ~free on the VPU where jnp.searchsorted's binary-search gathers cost
+    # hundreds of ms at this shape (measured, PROFILE.md).
+    pa = jnp.arange(LA + 2, dtype=jnp.int32)[None, :]
+    vgrid = pa - t_off[:, None]                       # [B, LA+2]
+    F = jnp.sum(X[:, :, None] < vgrid[:, None, :], axis=1,
+                dtype=jnp.int32)                      # [B, LA+2]
+    Fa = F[:, :-1]                                    # F(c) at p
+    F1 = F[:, 1:]                                     # F(c+1) at p
+
+    ltc = lt[:, None]
+    c = vgrid[:, :-1]                                 # slice-rel position at p
+    in_cols = (c >= 0) & (c < ltc)                    # column p exists
+    in_gaps = (c >= 0) & (c <= ltc)                   # gap p exists
+
+    # Insertion run before column c: block minus its t-step (absent at c==lt).
+    ins_len = jnp.where(in_gaps,
+                        F1 - Fa - jnp.where(c < ltc, 1, 0), 0)  # [B, LA+1]
+    qstart = _take1(cq_excl, Fa)                      # q idx of first ins base
+
+    # The op consuming column c.
+    s_step = F1 - 1
+    op_at = _take1(ops.astype(jnp.int32), s_step)
+    qi = _take1(cq_excl, s_step)                      # q idx matched at c
+    is_match = in_cols & (op_at == DIAG)
+    is_del = in_cols & (op_at == LEFT)
+
+    qx = q.astype(jnp.int32)
+    colbase = _take1(qx, qi)
+    colw = _take1(qw, qi)
+    wq = jnp.where(is_match, colw, w_read[:, None])   # per-column path weight
+
+    cols = in_cols[:, :LA]
+    base_idx = jnp.where(is_match[:, :LA], colbase[:, :LA], NBASE)  # 5 = del
+    col_w = jnp.where(cols, jnp.where(is_match[:, :LA], colw[:, :LA],
+                                      w_read[:, None]), 0.0)
+    col_oh = _onehot(base_idx, NBASE + 1)
+    col_w_ch = col_oh * col_w[..., None]                       # [B, LA, 6]
+    col_c_ch = col_oh[..., :NBASE] * (is_match[:, :LA] &
+                                      cols)[..., None]         # [B, LA, 5]
+
+    # Direct crossings: columns c-1 and c both consumed, no insertion between.
+    crossed = (c >= 1) & (c <= ltc - 1) & (ins_len == 0)
+    wq_prev = jnp.concatenate([w_read[:, None], wq[:, :LA]], axis=1)
+    cross_w = jnp.where(crossed, 0.5 * (wq_prev + wq), 0.0)    # [B, LA+1]
+
+    # Insertions.
+    has1 = in_gaps & (ins_len == 1)
+    multi = in_gaps & (ins_len >= 2)
+    b1 = _take1(qx, qstart)
+    w1 = _take1(qw, qstart)
+    ins1_oh = _onehot(jnp.where(has1, b1, NBASE), NBASE + 1)[..., :NBASE]
+    ins1_w_ch = ins1_oh * jnp.where(has1, w1, 0.0)[..., None]
+    ins1_c_ch = ins1_oh * has1[..., None]
+    ins1_stop = jnp.where(has1, w1, 0.0)
+
+    # Pileup columns k = 0..K-1 for multi-base runs.
+    pk_w, pk_c = [], []
+    for k in range(K_INS):
+        inrun = multi & (ins_len > k)
+        bk = _take1(qx, qstart + k)
+        wk = _take1(qw, qstart + k)
+        oh = _onehot(jnp.where(inrun, bk, NBASE), NBASE + 1)[..., :NBASE]
+        pk_w.append(oh * jnp.where(inrun, wk, 0.0)[..., None])
+        pk_c.append(oh * inrun[..., None])
+    pile_w_ch = jnp.stack(pk_w, axis=2)               # [B, LA+1, K, 5]
+    pile_c_ch = jnp.stack(pk_c, axis=2)
+
+    # Run mean weight -> stop-weight by run length (lengths 2..K).
+    qwcum = jnp.concatenate(
+        [jnp.zeros((B, 1), jnp.float32), jnp.cumsum(qw, axis=1)], axis=1)
+    run_sum = _take1(qwcum, qstart + ins_len) - _take1(qwcum, qstart)
+    wmean = jnp.where(multi, run_sum / jnp.maximum(ins_len, 1), 0.0)
+    lw_oh = (jnp.clip(ins_len, 0, K_INS)[..., None] ==
+             jnp.arange(2, K_INS + 1)[None, None, :])
+    lenw_ch = lw_oh * (wmean * multi)[..., None]      # [B, LA+1, K-1]
+
+    return {
+        "col_w": col_w_ch, "col_c": col_c_ch,
+        "cross_w": cross_w[..., None],
+        "ins1_w": ins1_w_ch, "ins1_c": ins1_c_ch,
+        "ins1_stop": ins1_stop[..., None],
+        "pile_w": pile_w_ch.reshape(B, LA + 1, -1),
+        "pile_c": pile_c_ch.reshape(B, LA + 1, -1),
+        "lenw": lenw_ch,
+        "is_del": is_del,  # unused downstream; kept for debugging
+    }
+
+
+def aggregate_votes(votes, win, n_win: int):
+    """Sum per-job channels into per-window accumulators via one-hot matmul."""
+    B = win.shape[0]
+    M = (jnp.arange(n_win, dtype=jnp.int32)[:, None] ==
+         win[None, :]).astype(jnp.float32)            # [Nw, B]
+
+    def agg(x):
+        flat = x.reshape(B, -1)
+        return jnp.matmul(M, flat, precision=_PREC).reshape(
+            (n_win,) + x.shape[1:])
+
+    col = agg(jnp.concatenate([votes["col_w"], votes["col_c"]], axis=-1))
+    gap = agg(jnp.concatenate(
+        [votes["cross_w"], votes["ins1_w"], votes["ins1_c"],
+         votes["ins1_stop"], votes["pile_w"], votes["pile_c"],
+         votes["lenw"]], axis=-1))
+    out = {}
+    out["base_w"] = col[..., :NBASE + 1]              # [Nw, LA, 6] (5=del)
+    out["base_c"] = col[..., NBASE + 1:]              # [Nw, LA, 5]
+    i = 0
+    out["direct_w"] = gap[..., i]; i += 1
+    out["ins1_w"] = gap[..., i:i + NBASE]; i += NBASE
+    out["ins1_c"] = gap[..., i:i + NBASE]; i += NBASE
+    out["ins1_stop"] = gap[..., i]; i += 1
+    out["pile_w"] = gap[..., i:i + K_INS * NBASE].reshape(
+        gap.shape[0], gap.shape[1], K_INS, NBASE); i += K_INS * NBASE
+    out["pile_c"] = gap[..., i:i + K_INS * NBASE].reshape(
+        gap.shape[0], gap.shape[1], K_INS, NBASE); i += K_INS * NBASE
+    out["lenw"] = gap[..., i:i + K_INS - 1]; i += K_INS - 1
+    return out
+
+
+def add_backbone(acc, bb, bbw, alen):
+    """Fold the backbone's votes in (sequence 0, epsilon tie-break)."""
+    Nw, LA = bb.shape
+    p = jnp.arange(LA, dtype=jnp.int32)[None, :]
+    vcol = p < alen[:, None]
+    oh = _onehot(bb.astype(jnp.int32), NBASE + 1)[..., :NBASE]
+    acc["base_w"] = acc["base_w"].at[..., :NBASE].add(
+        oh * (jnp.where(vcol, bbw + EPS, 0.0))[..., None])
+    acc["base_c"] = acc["base_c"] + oh * vcol[..., None]
+    bw0 = bbw[:, :1]
+    bwl = _take1(bbw, jnp.maximum(alen - 1, 0)[:, None])
+    left = jnp.concatenate([bw0, bbw], axis=1)        # bw[p-1], bw[0] at p=0
+    right = jnp.concatenate([bbw, bwl], axis=1)       # bw[p], bw[L-1] at p=L
+    # Right operand must be bw[alen-1] at p == alen (anchors are padded).
+    pg = jnp.arange(LA + 1, dtype=jnp.int32)[None, :]
+    right = jnp.where(pg == alen[:, None], bwl, right)
+    left = jnp.where(pg == alen[:, None], bwl, left)
+    vgap = pg <= alen[:, None]
+    cross = 0.5 * (left + right)
+    acc["direct_w"] = acc["direct_w"] + jnp.where(vgap, cross + EPS, 0.0)
+    return acc
+
+
+def assemble(acc, alen, ins_scale: float):
+    """Vote out consensus into the padded slot layout + coordinate maps.
+
+    Returns dict with:
+      codes  u8 [Nw, (LA+1)*(K+1)] slot codes (gap ins slots then column)
+      valid  bool same shape
+      cov    i32 same shape
+      total  i32 [Nw] new consensus lengths
+      pos    i32 [Nw, LA] landing position of each kept column
+      kept   bool [Nw, LA]
+    """
+    base_w, base_c = acc["base_w"], acc["base_c"]
+    Nw, LA, _ = base_c.shape
+    p = jnp.arange(LA, dtype=jnp.int32)[None, :]
+    vcol = p < alen[:, None]
+    pg = jnp.arange(LA + 1, dtype=jnp.int32)[None, :]
+    vgap = pg <= alen[:, None]
+
+    best_code = jnp.argmax(base_w[..., :NBASE], axis=-1)
+    best_w = jnp.take_along_axis(base_w[..., :NBASE], best_code[..., None],
+                                 axis=-1)[..., 0]
+    del_w = base_w[..., NBASE]
+    kept = vcol & (del_w <= best_w)
+    cov = jnp.take_along_axis(base_c, best_code[..., None], axis=-1)[..., 0]
+
+    # Gap emission: K sequential pileup columns (col 0 folds single runs).
+    stopped = acc["direct_w"] * ins_scale
+    emit_prev = vgap
+    ins_codes, ins_cnt, ins_emit = [], [], []
+    for k in range(K_INS):
+        cw = acc["pile_w"][:, :, k, :]
+        cc = acc["pile_c"][:, :, k, :]
+        if k == 0:
+            cw = cw + acc["ins1_w"]
+            cc = cc + acc["ins1_c"]
+        tot = jnp.sum(cw, axis=-1)
+        em = emit_prev & (tot > stopped)
+        bk = jnp.argmax(cw, axis=-1)
+        ck = jnp.take_along_axis(cc, bk[..., None], axis=-1)[..., 0]
+        ins_codes.append(bk)
+        ins_cnt.append(ck)
+        ins_emit.append(em)
+        emit_prev = em
+        # stopped += len_w[k+1] (+ single-run stops after column 0)
+        if k == 0:
+            stopped = stopped + acc["ins1_stop"]
+        if k + 1 >= 2 and (k + 1) - 2 < acc["lenw"].shape[-1]:
+            stopped = stopped + acc["lenw"][..., (k + 1) - 2]
+
+    ins_codes = jnp.stack(ins_codes, axis=2)          # [Nw, LA+1, K]
+    ins_cnt = jnp.stack(ins_cnt, axis=2)
+    ins_emit = jnp.stack(ins_emit, axis=2)
+
+    # Slot layout per gap p: K insertion slots, then column p's slot.
+    col_slot_code = jnp.concatenate(
+        [best_code, jnp.zeros((Nw, 1), best_code.dtype)], axis=1)
+    col_slot_cov = jnp.concatenate(
+        [cov, jnp.zeros((Nw, 1), cov.dtype)], axis=1)
+    col_slot_valid = jnp.concatenate(
+        [kept, jnp.zeros((Nw, 1), bool)], axis=1)
+    codes = jnp.concatenate(
+        [ins_codes, col_slot_code[..., None]], axis=2)      # [Nw, LA+1, K+1]
+    covs = jnp.concatenate(
+        [ins_cnt, col_slot_cov[..., None]], axis=2).astype(jnp.int32)
+    valids = jnp.concatenate(
+        [ins_emit, col_slot_valid[..., None]], axis=2)
+
+    S = (LA + 1) * (K_INS + 1)
+    vflat = valids.reshape(Nw, S)
+    cum = jnp.cumsum(vflat, axis=1, dtype=jnp.int32)
+    total = cum[:, -1]
+
+    fi = p * (K_INS + 1) + K_INS                     # column p's flat slot
+    pos = _take1(cum, fi) - 1                        # landing pos (if kept)
+
+    return {
+        "codes": codes.reshape(Nw, S).astype(jnp.uint8),
+        "valid": vflat,
+        "cum": cum,
+        "cov": covs.reshape(Nw, S),
+        "total": total,
+        "pos": pos,
+        "kept": kept,
+    }
+
+
+def compact(asm, out_len: int):
+    """Gather-based stream compaction of the slot layout.
+
+    Returns (codes u8 [Nw, out_len], cov i32 [Nw, out_len], total i32[Nw]).
+    Slots beyond ``total`` hold code 0 / cov 0.
+    """
+    cum = asm["cum"]
+    Nw = cum.shape[0]
+    pp = jnp.arange(out_len, dtype=jnp.int32)
+    # searchsorted-left(cum, p+1) == count of cum entries < p+1.
+    inv = jnp.sum(cum[:, :, None] < (pp + 1)[None, None, :], axis=1,
+                  dtype=jnp.int32)
+    live = pp[None, :] < asm["total"][:, None]
+    codes = jnp.where(live, _take1(asm["codes"].astype(jnp.int32), inv), 0)
+    cov = jnp.where(live, _take1(asm["cov"], inv), 0)
+    return codes.astype(jnp.uint8), cov, asm["total"]
+
+
+def coord_maps(asm, alen, LA: int):
+    """map_b / map_e: for every old-anchor position, the landing position of
+    the nearest kept column at-or-after / at-or-before it (falling back to
+    the last / first kept column, 0 when none are kept) — the coordinate
+    maps refinement rounds use to re-slice layer spans."""
+    kept, pos = asm["kept"], asm["pos"]
+    Nw = kept.shape[0]
+    posk = jnp.where(kept, pos, _HI)
+    # reverse cummin
+    map_b = jnp.flip(jax.lax.cummin(jnp.flip(posk, axis=1), axis=1), axis=1)
+    posk2 = jnp.where(kept, pos, -_HI)
+    map_e = jax.lax.cummax(posk2, axis=1)
+    any_kept = jnp.any(kept, axis=1, keepdims=True)
+    last_kept = jnp.max(jnp.where(kept, pos, -_HI), axis=1, keepdims=True)
+    first_kept = jnp.min(jnp.where(kept, pos, _HI), axis=1, keepdims=True)
+    map_b = jnp.where(map_b == _HI, last_kept, map_b)
+    map_e = jnp.where(map_e == -_HI, first_kept, map_e)
+    map_b = jnp.where(any_kept, map_b, 0)
+    map_e = jnp.where(any_kept, map_e, 0)
+    hi = jnp.maximum(asm["total"][:, None] - 1, 0)
+    map_b = jnp.clip(map_b, 0, hi)
+    map_e = jnp.clip(map_e, 0, hi)
+    return map_b.astype(jnp.int32), map_e.astype(jnp.int32)

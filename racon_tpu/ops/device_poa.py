@@ -1,0 +1,248 @@
+"""Device-resident POA consensus engine — the TPU hot path.
+
+Round-2's engine shipped alignment ops to the host and merged in numpy
+every refinement round; on a tunneled TPU (30 MB/s, ~75 ms per
+synchronized dispatch — see PROFILE.md) that cost ~10x the compute. This
+engine keeps the whole refinement loop on device:
+
+  h2d once:  encoded layer codes/weights, backbone anchors, spans
+  per round (no host sync, chained dispatch):
+    - job geometry from spans (full-span 1% rule, src/window.cpp:82)
+    - shifted target buffer by gather from the current anchors
+    - banded NW forward (Pallas kernel on TPU, XLA fallback elsewhere)
+    - batched banded traceback (one scan for all lanes)
+    - vote extraction + window aggregation + assembly + compaction
+      (racon_tpu/ops/device_merge.py) -> next round's anchors + spans,
+      all device-side
+  d2h once:  compact consensus codes + coverage + lengths + edge stats
+
+Semantics match PoaEngine's numpy path bit-for-bit on integer weights
+(differentially tested); the banded alignment equals the native adaptive
+aligner's first pass wherever the traceback stays off the artificial band
+edge (flagged lanes are counted and reported).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from racon_tpu.models.window import Window, window_arrays
+from racon_tpu.ops.encode import ALPHABET
+from racon_tpu.ops import flat as flatmod
+from racon_tpu.ops.flat import PAD_OP
+
+# Keep Lq * B * Lt under int32 flat-index range for the traceback gather.
+MAX_DIR_ELEMS = 1_600_000_000
+
+LA_GROW = 128      # anchor slack for insertion growth across rounds
+
+
+def _round_up(n: int, mult: int) -> int:
+    return ((max(n, 1) + mult - 1) // mult) * mult
+
+
+def dir_elems(n_jobs: int, max_lq: int, max_bb: int) -> int:
+    """Dirs-tensor element count for a chunk, with ChunkPlan's padding."""
+    return (_round_up(n_jobs, 128) * _round_up(max_lq, 32) *
+            _round_up(max_bb + LA_GROW, 128))
+
+
+class ChunkPlan:
+    """Host-side padded arrays for one device chunk (static shapes)."""
+
+    def __init__(self, windows: List[Window], la_grow: int = LA_GROW,
+                 b_mult: int = 128):
+        self.windows = windows
+        jobs_q: List[np.ndarray] = []
+        jobs_w: List[np.ndarray] = []
+        begin: List[int] = []
+        end: List[int] = []
+        win: List[int] = []
+        anchors: List[np.ndarray] = []
+        anchor_w: List[np.ndarray] = []
+        for wi, w in enumerate(windows):
+            lays, bb, bw = window_arrays(w)
+            for codes, wts, b, e in lays:
+                jobs_q.append(codes)
+                jobs_w.append(wts)
+                begin.append(b)
+                end.append(e)
+                win.append(wi)
+            anchors.append(bb)
+            anchor_w.append(bw)
+
+        self.n_win = len(windows)
+        self.n_jobs = len(jobs_q)
+        B = _round_up(self.n_jobs, b_mult)
+        Lq = _round_up(max(len(q) for q in jobs_q), 32)
+        LA0 = max(len(a) for a in anchors)
+        LA = _round_up(LA0 + la_grow, 128)
+        self.B, self.Lq, self.LA = B, Lq, LA
+        self.steps = Lq + LA
+
+        self.q = np.zeros((B, Lq), np.uint8)
+        # Weights ship as uint8 (value+1, 0 = padding) and decode on device
+        # — a 4x smaller h2d than f32 weights on a ~30 MB/s tunnel.
+        self.qw8 = np.zeros((B, Lq), np.uint8)
+        self.lq = np.ones(B, np.int32)
+        self.w_read = np.zeros(B, np.float32)
+        # Padded lanes point at a dummy extra window (n_win) so their votes
+        # aggregate into a discarded row.
+        self.win = np.full(B, self.n_win, np.int32)
+        self.begin = np.zeros(B, np.int32)
+        self.end = np.ones(B, np.int32)
+        for b in range(self.n_jobs):
+            ql = len(jobs_q[b])
+            self.q[b, :ql] = jobs_q[b]
+            self.qw8[b, :ql] = jobs_w[b].astype(np.uint8) + 1
+            self.lq[b] = ql
+            self.w_read[b] = float(jobs_w[b].astype(np.float64).mean()) \
+                if ql else 0.0
+            self.win[b] = win[b]
+            self.begin[b] = begin[b]
+            self.end[b] = end[b]
+
+        Nw = self.n_win + 1   # + dummy row for padded lanes
+        self.bb = np.zeros((Nw, LA), np.uint8)
+        self.bbw = np.zeros((Nw, LA), np.float32)
+        self.alen = np.ones(Nw, np.int32)
+        for wi in range(self.n_win):
+            L = len(anchors[wi])
+            self.bb[wi, :L] = anchors[wi]
+            self.bbw[wi, :L] = anchor_w[wi]
+            self.alen[wi] = L
+
+
+def _use_pallas(B: int, Lq: int, LA: int) -> bool:
+    import jax
+    from racon_tpu.ops.pallas.flat_kernel import TB, CH
+    if jax.default_backend() not in ("tpu", "axon"):
+        return False
+    return B % TB == 0 and Lq % CH == 0 and LA % 128 == 0
+
+
+@functools.partial(
+    __import__("jax").jit,
+    static_argnames=("match", "mismatch", "gap", "ins_scale", "Lq", "steps",
+                     "n_win", "LA", "pallas"))
+def device_round(bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, *,
+                 match, mismatch, gap, ins_scale, Lq, steps, n_win,
+                 LA, pallas):
+    """One alignment + merge round, fully on device.
+
+    Returns (new_bb, new_bbw, new_alen, new_begin, new_end, cov).
+    """
+    import jax
+    import jax.numpy as jnp
+    from racon_tpu.ops import device_merge as dm
+
+    B = q.shape[0]
+    L = jnp.take(alen, win)                             # anchor len per job
+    b_c = jnp.clip(begin, 0, L - 1)
+    e_c = jnp.clip(end, b_c, L - 1)
+    # uint32 offset = 0.01 * L, strict end > L - offset (window.cpp:82).
+    offs = (0.01 * L.astype(jnp.float32)).astype(jnp.int32)
+    full = (b_c < offs) & (e_c > L - offs)
+    t_off = jnp.where(full, 0, b_c).astype(jnp.int32)
+    lt = jnp.where(full, L, e_c - b_c + 1).astype(jnp.int32)
+
+    # Target buffer in absolute coordinates: tbuf[b, x] = anchor slice.
+    x = jnp.arange(LA, dtype=jnp.int32)[None, :]
+    ok = x < lt[:, None]
+    flat = bb.reshape(-1)
+    gidx = (win[:, None] * LA + jnp.clip(t_off[:, None] + x, 0, LA - 1))
+    tbuf = jnp.where(ok, jnp.take(flat, gidx), 7).astype(jnp.uint8)
+
+    if pallas:
+        from racon_tpu.ops.pallas.flat_kernel import fw_dirs_pallas
+        dirs = fw_dirs_pallas(tbuf, q.T,
+                              match=match, mismatch=mismatch, gap=gap)
+    else:
+        dirs = flatmod.fw_dirs_xla(tbuf, q.T,
+                                   match=match, mismatch=mismatch, gap=gap)
+    rev = flatmod.fw_traceback(dirs, lq, lt, steps)
+    ops = jnp.flip(rev, axis=1)
+
+    qw = jnp.maximum(qw8.astype(jnp.float32) - 1.0, 0.0)
+    votes = dm.extract_votes(ops, q, qw, w_read, lt, t_off, LA)
+    acc = dm.aggregate_votes(votes, win, n_win + 1)
+    acc = {k: v[:-1] for k, v in acc.items()}       # drop padded-lane row
+    acc = dm.add_backbone(acc, bb[:-1], bbw[:-1], alen[:-1])
+    asm = dm.assemble(acc, alen[:-1], ins_scale)
+    codes, cov, total = dm.compact(asm, LA)
+    map_b, map_e = dm.coord_maps(asm, alen[:-1], LA)
+
+    # Next-round anchors (dummy row re-appended) and remapped spans.
+    new_bb = jnp.concatenate([codes, bb[-1:]], axis=0)
+    new_bbw = jnp.zeros_like(bbw)
+    new_alen = jnp.concatenate(
+        [jnp.clip(total, 1, LA), alen[-1:]], axis=0).astype(jnp.int32)
+    mb_flat = map_b.reshape(-1)
+    me_flat = map_e.reshape(-1)
+    winc = jnp.minimum(win, map_b.shape[0] - 1)
+    nb = jnp.where(begin < L,
+                   jnp.take(mb_flat, winc * LA + jnp.clip(begin, 0, LA - 1)),
+                   0).astype(jnp.int32)
+    tot_j = jnp.take(jnp.clip(total, 1, LA), winc)
+    ne = jnp.where(end < L,
+                   jnp.take(me_flat, winc * LA + jnp.clip(end, 0, LA - 1)),
+                   tot_j - 1).astype(jnp.int32)
+    return new_bb, new_bbw, new_alen, nb, ne, cov
+
+
+@functools.partial(__import__("jax").jit)
+def _pack_out(codes, cov, alen):
+    """Flatten codes/cov/lengths into one uint8 buffer for a single d2h
+    transfer (each synchronized pull pays ~75 ms tunnel latency)."""
+    import jax
+    import jax.numpy as jnp
+    c16 = jnp.clip(cov, 0, 32767).astype(jnp.int16)
+    tail = alen.astype(jnp.int32)
+    return jnp.concatenate([
+        codes.reshape(-1),
+        jax.lax.bitcast_convert_type(c16, jnp.uint8).reshape(-1),
+        jax.lax.bitcast_convert_type(tail, jnp.uint8).reshape(-1),
+    ])
+
+
+def run_chunk(plan: ChunkPlan, *, match: int, mismatch: int, gap: int,
+              ins_scale: float, rounds: int
+              ) -> Tuple[List[bytes], List[np.ndarray]]:
+    """Execute all refinement rounds for a chunk; one h2d, one d2h.
+
+    Returns (consensus codes bytes per window, coverage arrays).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    pallas = _use_pallas(plan.B, plan.Lq, plan.LA)
+    dev_args = jax.device_put((plan.bb, plan.bbw, plan.alen, plan.begin,
+                               plan.end, plan.q, plan.qw8, plan.lq,
+                               plan.w_read, plan.win))
+    bb, bbw, alen, begin, end, q, qw8, lq, w_read, win = dev_args
+    cov = None
+    for _ in range(rounds):
+        bb, bbw, alen, begin, end, cov = device_round(
+            bb, bbw, alen, begin, end, q, qw8, lq, w_read, win,
+            match=match, mismatch=mismatch, gap=gap, ins_scale=ins_scale,
+            Lq=plan.Lq, steps=plan.steps, n_win=plan.n_win,
+            LA=plan.LA, pallas=pallas)
+
+    # One synchronized pull: everything packed into a single uint8 buffer.
+    Nw, LA = plan.n_win, plan.LA
+    packed = _pack_out(bb[:-1], cov, alen[:-1])
+    ph = np.asarray(packed)
+    codes_h = ph[:Nw * LA].reshape(Nw, LA)
+    cov_h = ph[Nw * LA:3 * Nw * LA].view(np.int16).reshape(Nw, LA)
+    alen_h = ph[3 * Nw * LA:].view(np.int32)[:Nw]
+
+    out_codes: List[bytes] = []
+    out_cov: List[np.ndarray] = []
+    for wi in range(plan.n_win):
+        L = int(alen_h[wi])
+        out_codes.append(codes_h[wi, :L].tobytes())
+        out_cov.append(cov_h[wi, :L].astype(np.int32))
+    return out_codes, out_cov

@@ -1,0 +1,91 @@
+"""Full-width batched NW forward + traceback in absolute target coordinates.
+
+The device engine's production alignment path. An earlier diagonal-banded
+variant needed a per-row rotated view of the target, and `pltpu.roll`
+with a dynamic shift silently corrupts rows wider than 512 lanes on the
+current Mosaic stack (PROFILE.md #6), so it was dropped in favor of
+absolute coordinates, which remove the rotation entirely:
+lane j-1 of every row is target position j, the substitution input is a
+*static* VMEM block, and padding needs no masking at all — cells beyond a
+job's true lt are garbage DP over padding that the traceback (which starts
+at (lq, lt) and only moves down-left) never visits.
+
+This is exact NW (same recurrence/tie-breaking as ops/align.py and the
+native aligner nw.cpp) — no band-edge heuristics, no touched flags.
+Replaces: spoa's sequence-vs-graph kNW (reference src/window.cpp:89-96)
+in backbone-anchored batched form.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from racon_tpu.ops.cigar import DIAG, UP, LEFT
+
+PAD_OP = 3
+_NEG = -(2 ** 30)
+
+
+@functools.partial(jax.jit, static_argnames=("match", "mismatch", "gap"))
+def fw_dirs_xla(tbuf: jnp.ndarray, qT: jnp.ndarray, *, match: int,
+                mismatch: int, gap: int) -> jnp.ndarray:
+    """Direction tensor uint8[Lq, B, Lt] via a row scan (CPU / fallback).
+
+    tbuf: uint8[B, Lt] targets (any filler beyond each job's lt).
+    qT:   uint8[Lq, B] queries (transposed).
+    """
+    B, Lt = tbuf.shape
+    jr = jnp.arange(Lt, dtype=jnp.int32)[None, :]
+    jg = (jr + 1) * gap
+    t32 = tbuf.astype(jnp.int32)
+    P0 = jg + jnp.zeros((B, 1), jnp.int32)            # H[0][j] = j*gap
+
+    def step(P, inp):
+        i, qrow = inp
+        sub = jnp.where(t32 == qrow[:, None], match, mismatch)
+        Pshift = jnp.concatenate(
+            [jnp.full((B, 1), (i - 1) * gap, jnp.int32), P[:, :-1]], axis=1)
+        diag = Pshift + sub
+        up = P + gap
+        tmp = jnp.maximum(diag, up)
+        # Left-gap chain with the H[i][0] = i*gap boundary folded in: its
+        # one-left-move path to column 1 is i*gap + gap, injected at lane 0.
+        f = jax.lax.cummax(jnp.maximum(tmp, (i + 1) * gap + jnp.where(
+            jr == 0, 0, _NEG)) - jg, axis=1)
+        h = f + jg
+        d = jnp.where(h == diag, DIAG,
+                      jnp.where(h == up, UP, LEFT)).astype(jnp.uint8)
+        return h, d
+
+    ii = jnp.arange(1, qT.shape[0] + 1, dtype=jnp.int32)
+    _, dirs = jax.lax.scan(step, P0, (ii, qT.astype(jnp.int32)))
+    return dirs
+
+
+def fw_traceback(dirs: jnp.ndarray, lq: jnp.ndarray, lt: jnp.ndarray,
+                 steps: int):
+    """Batched walk from (lq, lt) to (0, 0); rev_ops uint8[B, steps]."""
+    Lq, B, Lt = dirs.shape
+    d1 = dirs.reshape(-1)
+    lane = jnp.arange(B, dtype=jnp.int32)
+
+    def step(state, _):
+        i, j = state
+        done = (i == 0) & (j == 0)
+        idx = (jnp.maximum(i - 1, 0) * (B * Lt) + lane * Lt
+               + jnp.maximum(j - 1, 0))
+        dv = jnp.take(d1, idx)
+        d = jnp.where(done, PAD_OP,
+                      jnp.where(i == 0, LEFT,
+                                jnp.where(j == 0, UP, dv))).astype(jnp.uint8)
+        i = i - jnp.where((d == DIAG) | (d == UP), 1, 0).astype(i.dtype)
+        j = j - jnp.where((d == DIAG) | (d == LEFT), 1, 0).astype(j.dtype)
+        return (i, j), d
+
+    (_, _), rev_ops = jax.lax.scan(
+        step, (lq.astype(jnp.int32), lt.astype(jnp.int32)), None,
+        length=steps)
+    return rev_ops.T

@@ -1,0 +1,98 @@
+"""Pallas TPU kernel for the full-width NW forward (flat.py semantics).
+
+Layout: TB=128 jobs on sublanes, absolute target positions on lanes. The
+target block is a *static* VMEM operand (no per-row rotation — see
+PROFILE.md #6 for why the rolled banded variant was abandoned), the
+previous-row state lives in a VMEM scratch across row-grid steps, and the
+left-gap chain closes with log2(Lt) shift-max steps.
+
+Bit-identical to flat.fw_dirs_xla (asserted in tests/test_flat.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from racon_tpu.ops.cigar import DIAG, UP, LEFT
+
+_NEG = -(2 ** 30)
+TB = 128   # jobs per grid program
+CH = 32    # query rows per grid step
+
+
+def _kernel(tbuf_ref, qT_ref, dirs_ref, prev_ref, *, match, mismatch, gap,
+            Lt):
+    c = pl.program_id(1)
+    jr = jax.lax.broadcasted_iota(jnp.int32, (TB, Lt), 1)
+    jg = (jr + 1) * gap
+    t32 = tbuf_ref[...]                    # [TB, Lt] int32 (static block)
+
+    @pl.when(c == 0)
+    def _():
+        prev_ref[:] = jg                   # H[0][j] = j*gap
+
+    shifts = []
+    k = 1
+    while k < Lt:
+        shifts.append(k)
+        k *= 2
+
+    def row(r, _):
+        i = c * CH + r + 1                 # 1-based global row
+        qrow = qT_ref[r]                   # [TB] int32
+        sub = jnp.where(t32 == qrow[:, None], match, mismatch).astype(
+            jnp.int32)
+        P = prev_ref[:]
+        Pshift = jnp.concatenate(
+            [jnp.full((TB, 1), (i - 1) * gap, jnp.int32), P[:, :-1]], axis=1)
+        diag = Pshift + sub
+        up = P + gap
+        tmp = jnp.maximum(diag, up)
+        boundary = jnp.where(jr == 0, (i + 1) * gap, _NEG)
+        f = jnp.maximum(tmp, boundary) - jg
+        for s in shifts:
+            f = jnp.maximum(
+                f, jnp.concatenate(
+                    [jnp.full((TB, s), _NEG, jnp.int32), f[:, :-s]], axis=1))
+        h = f + jg
+        d = jnp.where(h == diag, DIAG,
+                      jnp.where(h == up, UP, LEFT)).astype(jnp.uint8)
+        dirs_ref[r] = d
+        prev_ref[:] = h
+        return 0
+
+    jax.lax.fori_loop(0, CH, row, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("match", "mismatch", "gap"))
+def fw_dirs_pallas(tbuf: jnp.ndarray, qT: jnp.ndarray, *, match: int,
+                   mismatch: int, gap: int) -> jnp.ndarray:
+    """Direction tensor uint8[Lq, B, Lt].
+
+    B must be a multiple of TB (128), Lq of CH (32), Lt of 128.
+    """
+    B, Lt = tbuf.shape
+    Lq = qT.shape[0]
+    kernel = functools.partial(_kernel, match=match, mismatch=mismatch,
+                               gap=gap, Lt=Lt)
+    return pl.pallas_call(
+        kernel,
+        grid=(B // TB, Lq // CH),
+        in_specs=[
+            pl.BlockSpec((TB, Lt), lambda b, c: (b, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((CH, TB), lambda b, c: (c, b),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((CH, TB, Lt), lambda b, c: (c, b, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((Lq, B, Lt), jnp.uint8),
+        scratch_shapes=[pltpu.VMEM((TB, Lt), jnp.int32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")),
+    )(tbuf.astype(jnp.int32), qT.astype(jnp.int32))

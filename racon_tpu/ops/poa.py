@@ -42,11 +42,18 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from racon_tpu.models.window import Window, sorted_layer_order
+from racon_tpu.models.window import Window, sorted_layer_order, \
+    window_arrays
 from racon_tpu.ops.encode import encode_bases, decode_bases, ALPHABET
 from racon_tpu.ops.cigar import DIAG, UP, LEFT
 
-_EPS = 1e-6
+# Tie-break epsilon, shared by the host (f64) and device (f32) merges so
+# they stay bit-comparable. 1e-3 survives f32 accumulation at realistic
+# weight sums (exact ties between integer-weight votes are the common
+# case); read-mean and crossing weights are fractional, so margins below
+# 1e-3 can in principle flip — accepted as tie-break noise (golden
+# edit-distance bounds in tests/test_polisher.py hold).
+_EPS = 1e-3
 
 
 class _Job:
@@ -58,7 +65,9 @@ class _Job:
         self.win = win
         self.q = q                      # uint8 base codes (query layer)
         self.w = w                      # float32 per-base weights
-        self.w_read = float(w.mean()) if len(w) else 0.0
+        # float64 mean so the native/C++ and device engines can reproduce
+        # it exactly (float32 pairwise mean is numpy-internal).
+        self.w_read = float(w.astype(np.float64).mean()) if len(w) else 0.0
         self.t = t                      # uint8 base codes (backbone slice)
         self.t_off = t_off              # backbone offset of the slice
         self.ops: Optional[np.ndarray] = None
@@ -123,35 +132,93 @@ class PoaEngine:
                 active.append(w)
         if not active:
             return 0
+        # The device engine does not shard yet; an explicit mesh routes
+        # through the host-orchestrated path whose aligner shards over dp
+        # (racon_tpu/parallel/dispatch.py).
+        if self.backend == "jax" and self.mesh is None:
+            dev, host = self._partition_device(active)
+            n = 0
+            if dev:
+                n += self._consensus_device(dev)
+            if host:
+                n += self._consensus_host(host, force_native=True)
+            return n
+        return self._consensus_host(active)
 
+    def _partition_device(self, windows: List[Window]):
+        """Split windows into device-engine vs host-path sets.
+
+        The full-width device kernel computes exact NW for any geometry,
+        so everything is device-eligible; only degenerate windows that
+        alone overflow the chunk's dirs-element cap fall back to the
+        host path.
+        """
+        from racon_tpu.ops.device_poa import dir_elems, MAX_DIR_ELEMS
+        dev, host = [], []
+        for w in windows:
+            lq = max(len(d) for d in w.layer_data)
+            if dir_elems(w.n_layers, lq, len(w.backbone)) > MAX_DIR_ELEMS:
+                host.append(w)
+            else:
+                dev.append(w)
+        return dev, host
+
+    def _consensus_device(self, active: List[Window]) -> int:
+        """Device-resident path: all refinement rounds on chip, one h2d /
+        one d2h per chunk (racon_tpu/ops/device_poa.py)."""
+        from racon_tpu.ops.device_poa import (ChunkPlan, run_chunk,
+                                              dir_elems, MAX_DIR_ELEMS)
+        order = sorted(range(len(active)),
+                       key=lambda i: len(active[i].backbone))
+        i = 0
+        while i < len(order):
+            ws: List[Window] = []
+            jobs = 0
+            max_lq = max_la = 1
+            while i < len(order):
+                w = active[order[i]]
+                n_lq = max(max_lq, max(len(d) for d in w.layer_data))
+                n_la = max(max_la, len(w.backbone))
+                n_jobs = jobs + w.n_layers
+                # Dirs tensor must stay under the int32 flat-index cap
+                # (padded dimensions, as ChunkPlan will size them).
+                if ws and (n_jobs > self.device_batch or
+                           dir_elems(n_jobs, n_lq, n_la) > MAX_DIR_ELEMS):
+                    break
+                ws.append(w)
+                jobs, max_lq, max_la = n_jobs, n_lq, n_la
+                i += 1
+            plan = ChunkPlan(ws)
+            codes, covs = run_chunk(
+                plan, match=self.match, mismatch=self.mismatch,
+                gap=self.gap, ins_scale=self.ins_scale,
+                rounds=self.refine_rounds + 1)
+            for w, c, cv in zip(ws, codes, covs):
+                w.apply_consensus(
+                    decode_bases(np.frombuffer(c, dtype=np.uint8)), cv,
+                    log=self.log)
+        return len(active)
+
+    def _consensus_host(self, active: List[Window],
+                        force_native: bool = False) -> int:
+        backend = self.backend
+        if force_native:
+            self.backend = "native"
+        try:
+            return self._consensus_host_impl(active)
+        finally:
+            self.backend = backend
+
+    def _consensus_host_impl(self, active: List[Window]) -> int:
         # Per-window state: current anchor (codes, weights) and layer maps
         # from original window coordinates into the current anchor.
         layers: List[List[Tuple[np.ndarray, np.ndarray, int, int]]] = []
         anchors: List[Tuple[np.ndarray, np.ndarray]] = []
         spans: List[List[Tuple[int, int]]] = []
         for w in active:
-            lst = []
-            sp = []
-            for li in sorted_layer_order(w):
-                data = bytes(w.layer_data[li])
-                qual = w.layer_quality[li]
-                codes = encode_bases(data)
-                if qual is not None:
-                    wts = (np.frombuffer(bytes(qual), dtype=np.uint8)
-                           .astype(np.float32) - 33.0)
-                else:
-                    wts = np.ones(len(data), dtype=np.float32)
-                lst.append((codes, wts))
-                sp.append((int(w.layer_begin[li]), int(w.layer_end[li])))
-            layers.append(lst)
-            spans.append(sp)
-            bb = encode_bases(bytes(w.backbone))
-            if w.backbone_quality is not None:
-                bb_w = (np.frombuffer(bytes(w.backbone_quality),
-                                      dtype=np.uint8)
-                        .astype(np.float32) - 33.0)
-            else:
-                bb_w = np.zeros(len(bb), dtype=np.float32)
+            lays, bb, bb_w = window_arrays(w)
+            layers.append([(codes, wts) for codes, wts, _, _ in lays])
+            spans.append([(b, e) for _, _, b, e in lays])
             anchors.append((bb, bb_w))
 
         results = None
@@ -188,14 +255,15 @@ class PoaEngine:
                     lst: List[Tuple[np.ndarray, np.ndarray]],
                     sp: List[Tuple[int, int]]) -> List[_Job]:
         L = len(bb)
-        offset = int(0.01 * L)
+        offset = int(0.01 * L)  # reference truncates to uint32
         jobs = []
         for (codes, wts), (begin, end) in zip(lst, sp):
             begin = max(0, min(begin, L - 1))
             end = max(begin, min(end, L - 1))
             # Full-span layers align to the whole backbone, partial layers
-            # to the [begin, end] slice (src/window.cpp:82-98, 1% offset).
-            if begin < offset and end > L - offset - 1:
+            # to the [begin, end] slice (src/window.cpp:82-98: uint32
+            # offset = 0.01 * L, strict `end > L - offset`).
+            if begin < offset and end > L - offset:
                 jobs.append(_Job(wi, codes, wts, bb, 0))
             else:
                 jobs.append(_Job(wi, codes, wts, bb[begin:end + 1], begin))
@@ -504,7 +572,8 @@ class _InsPileup:
                 self.col_c.append(np.zeros(ALPHABET, dtype=np.int32))
             self.col_w[k][seg[k]] += w[k]
             self.col_c[k][seg[k]] += 1
-        self.len_w[len(seg)] = self.len_w.get(len(seg), 0.0) + float(w.mean())
+        self.len_w[len(seg)] = self.len_w.get(len(seg), 0.0) + \
+            float(w.astype(np.float64).mean())
 
     def consensus(self, direct: float, extra0_w=None, extra0_c=None,
                   extra_stop1: float = 0.0

@@ -1,0 +1,104 @@
+"""Device merge (ops/device_merge.py) vs the numpy reference merge.
+
+The device merge reformulates _merge_round's scatters as gathers +
+one-hot matmuls; on integer-valued weights its consensus, coverage, and
+coordinate maps must be bit-identical to the numpy implementation
+(which itself mirrors spoa's add_alignment/generate_consensus,
+reference src/window.cpp:100-111).
+"""
+
+import numpy as np
+import pytest
+
+from racon_tpu.models.window import sorted_layer_order
+from racon_tpu.ops.encode import encode_bases
+from racon_tpu.ops.poa import PoaEngine
+from racon_tpu.ops import device_merge as dm
+from tests.test_flat import _build_windows
+
+
+@pytest.mark.parametrize("with_quality", [True, False])
+def test_device_merge_matches_numpy(with_quality):
+    import jax.numpy as jnp
+    windows = _build_windows(7, 5, 10, 220, with_quality)
+    eng = PoaEngine(backend="native")
+    active = [w for w in windows if w.n_layers >= 2]
+
+    layers, anchors, spans = [], [], []
+    for w in active:
+        lst, sp = [], []
+        for li in sorted_layer_order(w):
+            data = bytes(w.layer_data[li])
+            qual = w.layer_quality[li]
+            codes = encode_bases(data)
+            if qual is not None:
+                wts = (np.frombuffer(bytes(qual), dtype=np.uint8)
+                       .astype(np.float32) - 33.0)
+            else:
+                wts = np.ones(len(data), dtype=np.float32)
+            lst.append((codes, wts))
+            sp.append((int(w.layer_begin[li]), int(w.layer_end[li])))
+        layers.append(lst)
+        spans.append(sp)
+        bb = encode_bases(bytes(w.backbone))
+        if w.backbone_quality is not None:
+            bw = (np.frombuffer(bytes(w.backbone_quality), dtype=np.uint8)
+                  .astype(np.float32) - 33.0)
+        else:
+            bw = np.zeros(len(bb), dtype=np.float32)
+        anchors.append((bb, bw))
+
+    jobs = []
+    for wi in range(len(active)):
+        jobs.extend(eng._build_jobs(wi, anchors[wi][0], layers[wi],
+                                    spans[wi]))
+    eng._align(jobs)
+    ref = eng._merge_round(anchors, jobs)
+
+    B = len(jobs)
+    S = max(len(j.ops) for j in jobs) + 8
+    Lq = max(len(j.q) for j in jobs)
+    LA = max(len(bb) for bb, _ in anchors) + 8
+    ops = np.full((B, S), dm.PAD_OP, np.uint8)
+    q = np.zeros((B, Lq), np.uint8)
+    qw = np.zeros((B, Lq), np.float32)
+    w_read = np.zeros(B, np.float32)
+    lt = np.zeros(B, np.int32)
+    t_off = np.zeros(B, np.int32)
+    win = np.zeros(B, np.int32)
+    for b, j in enumerate(jobs):
+        ops[b, S - len(j.ops):] = j.ops
+        q[b, :len(j.q)] = j.q
+        qw[b, :len(j.q)] = j.w
+        w_read[b] = j.w_read
+        lt[b] = j.t_len
+        t_off[b] = j.t_off
+        win[b] = j.win
+    Nw = len(anchors)
+    bb_pad = np.zeros((Nw, LA), np.uint8)
+    bbw_pad = np.zeros((Nw, LA), np.float32)
+    alen = np.zeros(Nw, np.int32)
+    for wi, (bb, bw) in enumerate(anchors):
+        bb_pad[wi, :len(bb)] = bb
+        bbw_pad[wi, :len(bb)] = bw
+        alen[wi] = len(bb)
+
+    votes = dm.extract_votes(jnp.asarray(ops), jnp.asarray(q),
+                             jnp.asarray(qw), jnp.asarray(w_read),
+                             jnp.asarray(lt), jnp.asarray(t_off), LA)
+    acc = dm.aggregate_votes(votes, jnp.asarray(win), Nw)
+    acc = dm.add_backbone(acc, jnp.asarray(bb_pad), jnp.asarray(bbw_pad),
+                          jnp.asarray(alen))
+    asm = dm.assemble(acc, jnp.asarray(alen), eng.ins_scale)
+    codes, cov, total = dm.compact(asm, LA + 64)
+    map_b, map_e = dm.coord_maps(asm, jnp.asarray(alen), LA)
+    codes, cov, total = map(np.asarray, (codes, cov, total))
+    map_b, map_e = np.asarray(map_b), np.asarray(map_e)
+
+    for wi, (cons_ref, cov_ref, mb_ref, me_ref) in enumerate(ref):
+        L = len(cons_ref)
+        assert total[wi] == L
+        assert np.array_equal(codes[wi, :L], cons_ref)
+        assert np.array_equal(cov[wi, :L], cov_ref)
+        assert np.array_equal(map_b[wi, :len(mb_ref)], mb_ref)
+        assert np.array_equal(map_e[wi, :len(me_ref)], me_ref)

@@ -1,0 +1,133 @@
+"""Full-width device NW (ops/flat.py) and the device engine end-to-end.
+
+Strategy mirrors the reference's differential discipline: the device
+kernels must be *bit-identical* to the numpy oracle / native C++ aligner
+(reference edlib+spoa semantics) — not merely close. Runs on the CPU
+backend (conftest forces it); the Pallas variants are asserted equal to
+the XLA variants on real TPU runs (racon_tpu/ops/pallas/flat_kernel.py).
+"""
+
+import numpy as np
+import pytest
+
+from racon_tpu.models.window import Window, WindowType
+from racon_tpu.ops.cigar import nw_oracle, DIAG, UP, LEFT
+from racon_tpu.ops.encode import decode_bases
+from racon_tpu.ops.flat import fw_dirs_xla, fw_traceback, PAD_OP
+from racon_tpu.ops.poa import PoaEngine
+
+M, X, G = 5, -4, -8
+
+
+def _score(q, t, ops):
+    i = j = s = 0
+    for d in ops:
+        if d == DIAG:
+            s += M if q[i] == t[j] else X
+            i += 1
+            j += 1
+        elif d == UP:
+            s += G
+            i += 1
+        else:
+            s += G
+            j += 1
+    assert i == len(q) and j == len(t)
+    return s
+
+
+def _mutate(rng, base, rate):
+    out = []
+    for b in base:
+        r = rng.random()
+        if r < rate / 3:
+            continue
+        if r < 2 * rate / 3:
+            out.append(rng.integers(0, 4))
+            continue
+        out.append(b)
+        if r < rate:
+            out.append(rng.integers(0, 4))
+    return np.asarray(out, np.uint8)
+
+
+def test_fw_paths_match_oracle():
+    """Batched full-width NW paths are bit-identical to the numpy oracle."""
+    import jax.numpy as jnp
+    rng = np.random.default_rng(5)
+    qs, ts = [], []
+    for trial in range(25):
+        L = int(rng.integers(4, 300))
+        t = rng.integers(0, 4, L).astype(np.uint8)
+        q = _mutate(rng, t, 0.2) if trial % 3 else \
+            rng.integers(0, 4, int(rng.integers(1, 200))).astype(np.uint8)
+        if len(q) == 0:
+            q = np.array([0], np.uint8)
+        qs.append(q)
+        ts.append(t)
+    B = len(qs)
+    Lq = max(len(q) for q in qs)
+    Lt = max(len(t) for t in ts)
+    tbuf = np.full((B, Lt), 7, np.uint8)
+    qT = np.zeros((Lq, B), np.uint8)
+    lq = np.zeros(B, np.int32)
+    lt = np.zeros(B, np.int32)
+    for b, (q, t) in enumerate(zip(qs, ts)):
+        tbuf[b, :len(t)] = t
+        qT[:len(q), b] = q
+        lq[b], lt[b] = len(q), len(t)
+    dirs = fw_dirs_xla(jnp.asarray(tbuf), jnp.asarray(qT),
+                       match=M, mismatch=X, gap=G)
+    steps = Lq + Lt
+    rev = np.asarray(fw_traceback(dirs, jnp.asarray(lq), jnp.asarray(lt),
+                                  steps))
+    for b in range(B):
+        ops = rev[b][rev[b] != PAD_OP][::-1]
+        ref_score, ref_ops = nw_oracle(qs[b], ts[b], M, X, G)
+        assert _score(qs[b], ts[b], ops) == ref_score
+        assert np.array_equal(ops, ref_ops), b
+
+
+def _build_windows(seed, n, cov, wlen, with_quality):
+    rng = np.random.default_rng(seed)
+    ws = []
+    for _ in range(n):
+        true = rng.integers(0, 4, wlen).astype(np.uint8)
+
+        def noisy():
+            return decode_bases(_mutate(rng, true, 0.12))
+
+        backbone = noisy()
+        bq = bytes(rng.integers(38, 53, len(backbone), dtype=np.uint8)) \
+            if with_quality else None
+        w = Window(0, 0, WindowType.TGS, backbone, bq)
+        for _ in range(cov):
+            lay = noisy()
+            lquals = bytes(rng.integers(38, 53, len(lay), dtype=np.uint8)) \
+                if with_quality else None
+            if rng.random() < 0.3 and len(backbone) > 60:
+                b0 = int(rng.integers(0, len(backbone) // 3))
+                e0 = int(rng.integers(2 * len(backbone) // 3,
+                                      len(backbone) - 1))
+                c0 = int(len(lay) * b0 / len(backbone))
+                c1 = int(len(lay) * e0 / len(backbone))
+                w.add_layer(lay[c0:c1], lquals[c0:c1] if lquals else None,
+                            b0, e0)
+            else:
+                w.add_layer(lay, lquals, 0, len(backbone) - 1)
+        ws.append(w)
+    return ws
+
+
+@pytest.mark.parametrize("with_quality", [True, False])
+def test_device_engine_matches_native(with_quality):
+    """The all-device engine's consensus is bit-identical to the host
+    native path (same alignments, same merge) on mixed full/partial-span
+    windows."""
+    w_dev = _build_windows(11, 6, 12, 260, with_quality)
+    w_nat = _build_windows(11, 6, 12, 260, with_quality)
+    PoaEngine(backend="jax").consensus_windows(w_dev)
+    PoaEngine(backend="native").consensus_windows(w_nat)
+    for a, b in zip(w_dev, w_nat):
+        assert a.consensus == b.consensus
+        assert a.polished == b.polished
