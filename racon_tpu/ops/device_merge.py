@@ -43,7 +43,13 @@ from racon_tpu.ops.poa import _EPS as EPS  # shared tie-break epsilon
 
 K_INS = 8          # pileup columns per gap kept on device
 NBASE = 5          # A C G T N
-_HI = jnp.int32(2 ** 30)
+# Python int, NOT jnp.int32: a module-level jax.Array closed over by a
+# jitted function lowers as a hoisted buffer parameter on some traces, and
+# jax 0.9's execution path then under-supplies the executable ("Execution
+# supplied 11 buffers but compiled program expected 12") — the root cause
+# of the round-3 INVALID_ARGUMENT crash on TPU (BENCH_r03; repro:
+# scripts/tpu_two_shape_repro.py). A Python scalar is always inlined.
+_HI = 2 ** 30
 
 _PREC = jax.lax.Precision.HIGHEST
 

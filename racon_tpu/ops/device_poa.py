@@ -44,17 +44,62 @@ def _round_up(n: int, mult: int) -> int:
     return ((max(n, 1) + mult - 1) // mult) * mult
 
 
+def _bucket_b(n: int) -> int:
+    """Batch-dim bucket: coarse grid so chunks reuse compiled executables."""
+    for cap in (128, 256, 512, 1024, 2048):
+        if n <= cap:
+            return cap
+    return _round_up(n, 1024)
+
+
+# Executable-reuse cap history, per process: every differently-shaped
+# device_round executable is a fresh multi-second XLA compile, so later
+# runs pad up to a previously-compiled (Lq, LA) pair when one covers them
+# within 2x per dim (beyond that, recompiling is cheaper than the padded
+# compute). jax's executable cache keys on the same shapes, so a history
+# hit is a compile-cache hit.
+_CAP_HISTORY: set = set()
+
+
+def run_caps(lq: int, la: int) -> Tuple[int, int]:
+    """(lq_cap, la_cap) covering a run's max layer/backbone lengths, on a
+    coarse grid."""
+    need = (_round_up(lq, 128), _round_up(la + LA_GROW, 128))
+    if 128 * need[0] * need[1] > MAX_DIR_ELEMS:
+        # Unusable even at the minimum batch bucket (caller falls back to
+        # the host path) — don't record it, or it would shadow smaller
+        # usable pairs for later runs.
+        return need
+    best = None
+    for c in _CAP_HISTORY:
+        if (need[0] <= c[0] <= 2 * need[0] and
+                need[1] <= c[1] <= 2 * need[1] and
+                128 * c[0] * c[1] <= MAX_DIR_ELEMS and
+                (best is None or c[0] * c[1] < best[0] * best[1])):
+            best = c
+    if best is None:
+        best = need
+        _CAP_HISTORY.add(need)
+    return best
+
+
 def dir_elems(n_jobs: int, max_lq: int, max_bb: int) -> int:
     """Dirs-tensor element count for a chunk, with ChunkPlan's padding."""
-    return (_round_up(n_jobs, 128) * _round_up(max_lq, 32) *
+    return (_bucket_b(n_jobs) * _round_up(max_lq, 128) *
             _round_up(max_bb + LA_GROW, 128))
 
 
 class ChunkPlan:
-    """Host-side padded arrays for one device chunk (static shapes)."""
+    """Host-side padded arrays for one device chunk (static shapes).
+
+    All dims pad onto coarse grids — B via ``_bucket_b``, Lq/LA via the
+    run-level caps from ``run_caps``, n_win onto multiples of 32 (dummy
+    windows with a 1-base zero anchor) — so every chunk of a run, and
+    repeated runs in one process, share a single compiled executable.
+    """
 
     def __init__(self, windows: List[Window], la_grow: int = LA_GROW,
-                 b_mult: int = 128):
+                 lq_cap: Optional[int] = None, la_cap: Optional[int] = None):
         self.windows = windows
         jobs_q: List[np.ndarray] = []
         jobs_w: List[np.ndarray] = []
@@ -74,12 +119,16 @@ class ChunkPlan:
             anchors.append(bb)
             anchor_w.append(bw)
 
-        self.n_win = len(windows)
+        self.n_real_win = len(windows)
+        self.n_win = _round_up(len(windows), 32)
         self.n_jobs = len(jobs_q)
-        B = _round_up(self.n_jobs, b_mult)
-        Lq = _round_up(max(len(q) for q in jobs_q), 32)
+        B = _bucket_b(self.n_jobs)
+        max_lq = max(len(q) for q in jobs_q)
         LA0 = max(len(a) for a in anchors)
-        LA = _round_up(LA0 + la_grow, 128)
+        Lq = lq_cap if lq_cap is not None else _round_up(max_lq, 128)
+        LA = la_cap if la_cap is not None else _round_up(LA0 + la_grow, 128)
+        if max_lq > Lq or LA0 + la_grow > LA:
+            raise ValueError("[racon_tpu::ChunkPlan] caps below chunk max")
         self.B, self.Lq, self.LA = B, Lq, LA
         self.steps = Lq + LA
 
@@ -97,7 +146,9 @@ class ChunkPlan:
         for b in range(self.n_jobs):
             ql = len(jobs_q[b])
             self.q[b, :ql] = jobs_q[b]
-            self.qw8[b, :ql] = jobs_w[b].astype(np.uint8) + 1
+            # Clip before the uint8 encode: malformed quality below '!'
+            # would otherwise wrap to a huge device weight.
+            self.qw8[b, :ql] = np.clip(jobs_w[b], 0, 254).astype(np.uint8) + 1
             self.lq[b] = ql
             self.w_read[b] = float(jobs_w[b].astype(np.float64).mean()) \
                 if ql else 0.0
@@ -109,7 +160,7 @@ class ChunkPlan:
         self.bb = np.zeros((Nw, LA), np.uint8)
         self.bbw = np.zeros((Nw, LA), np.float32)
         self.alen = np.ones(Nw, np.int32)
-        for wi in range(self.n_win):
+        for wi in range(self.n_real_win):
             L = len(anchors[wi])
             self.bb[wi, :L] = anchors[wi]
             self.bbw[wi, :L] = anchor_w[wi]
@@ -117,8 +168,11 @@ class ChunkPlan:
 
 
 def _use_pallas(B: int, Lq: int, LA: int) -> bool:
+    import os
     import jax
     from racon_tpu.ops.pallas.flat_kernel import TB, CH
+    if os.environ.get("RACON_TPU_NO_PALLAS", "") not in ("", "0", "false"):
+        return False                               # debug/safety valve
     if jax.default_backend() not in ("tpu", "axon"):
         return False
     return B % TB == 0 and Lq % CH == 0 and LA % 128 == 0
@@ -128,12 +182,15 @@ def _use_pallas(B: int, Lq: int, LA: int) -> bool:
     __import__("jax").jit,
     static_argnames=("match", "mismatch", "gap", "ins_scale", "Lq", "steps",
                      "n_win", "LA", "pallas"))
-def device_round(bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, *,
+def device_round(bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf, *,
                  match, mismatch, gap, ins_scale, Lq, steps, n_win,
                  LA, pallas):
     """One alignment + merge round, fully on device.
 
-    Returns (new_bb, new_bbw, new_alen, new_begin, new_end, cov).
+    Returns (new_bb, new_bbw, new_alen, new_begin, new_end, cov, ovf).
+    ``ovf`` is a sticky per-window flag: consensus outgrew the padded
+    anchor width this round (or any earlier one) and was truncated —
+    the host must re-run those windows (the host path is unbounded).
     """
     import jax
     import jax.numpy as jnp
@@ -144,7 +201,11 @@ def device_round(bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, *,
     b_c = jnp.clip(begin, 0, L - 1)
     e_c = jnp.clip(end, b_c, L - 1)
     # uint32 offset = 0.01 * L, strict end > L - offset (window.cpp:82).
-    offs = (0.01 * L.astype(jnp.float32)).astype(jnp.int32)
+    # Integer floor-div matches the host's f64 `int(0.01 * L)` exactly for
+    # all realistic L (f64 0.01 is slightly above 1/100, so truncation
+    # equals floor division); f32 on device would disagree near multiples
+    # of 100 (e.g. L=300).
+    offs = L // 100
     full = (b_c < offs) & (e_c > L - offs)
     t_off = jnp.where(full, 0, b_c).astype(jnp.int32)
     lt = jnp.where(full, L, e_c - b_c + 1).astype(jnp.int32)
@@ -190,13 +251,15 @@ def device_round(bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, *,
     ne = jnp.where(end < L,
                    jnp.take(me_flat, winc * LA + jnp.clip(end, 0, LA - 1)),
                    tot_j - 1).astype(jnp.int32)
-    return new_bb, new_bbw, new_alen, nb, ne, cov
+    ovf = ovf | (total > LA)
+    return new_bb, new_bbw, new_alen, nb, ne, cov, ovf
 
 
 @functools.partial(__import__("jax").jit)
-def _pack_out(codes, cov, alen):
-    """Flatten codes/cov/lengths into one uint8 buffer for a single d2h
-    transfer (each synchronized pull pays ~75 ms tunnel latency)."""
+def _pack_out(codes, cov, alen, ovf):
+    """Flatten codes/cov/lengths/overflow into one uint8 buffer for a
+    single d2h transfer (each synchronized pull pays ~75 ms tunnel
+    latency)."""
     import jax
     import jax.numpy as jnp
     c16 = jnp.clip(cov, 0, 32767).astype(jnp.int16)
@@ -205,15 +268,19 @@ def _pack_out(codes, cov, alen):
         codes.reshape(-1),
         jax.lax.bitcast_convert_type(c16, jnp.uint8).reshape(-1),
         jax.lax.bitcast_convert_type(tail, jnp.uint8).reshape(-1),
+        ovf.astype(jnp.uint8),
     ])
 
 
 def run_chunk(plan: ChunkPlan, *, match: int, mismatch: int, gap: int,
               ins_scale: float, rounds: int
-              ) -> Tuple[List[bytes], List[np.ndarray]]:
+              ) -> Tuple[List[Optional[bytes]], List[Optional[np.ndarray]]]:
     """Execute all refinement rounds for a chunk; one h2d, one d2h.
 
-    Returns (consensus codes bytes per window, coverage arrays).
+    Returns (consensus codes bytes per window, coverage arrays). A window
+    whose consensus outgrew the padded anchor width (sticky ``ovf`` flag)
+    yields ``None`` in both lists — the caller must re-run it on the
+    unbounded host path instead of shipping a silently truncated string.
     """
     import jax
     import jax.numpy as jnp
@@ -224,24 +291,30 @@ def run_chunk(plan: ChunkPlan, *, match: int, mismatch: int, gap: int,
                                plan.w_read, plan.win))
     bb, bbw, alen, begin, end, q, qw8, lq, w_read, win = dev_args
     cov = None
+    ovf = jnp.zeros(plan.n_win, dtype=bool)
     for _ in range(rounds):
-        bb, bbw, alen, begin, end, cov = device_round(
-            bb, bbw, alen, begin, end, q, qw8, lq, w_read, win,
+        bb, bbw, alen, begin, end, cov, ovf = device_round(
+            bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf,
             match=match, mismatch=mismatch, gap=gap, ins_scale=ins_scale,
             Lq=plan.Lq, steps=plan.steps, n_win=plan.n_win,
             LA=plan.LA, pallas=pallas)
 
     # One synchronized pull: everything packed into a single uint8 buffer.
     Nw, LA = plan.n_win, plan.LA
-    packed = _pack_out(bb[:-1], cov, alen[:-1])
+    packed = _pack_out(bb[:-1], cov, alen[:-1], ovf)
     ph = np.asarray(packed)
     codes_h = ph[:Nw * LA].reshape(Nw, LA)
     cov_h = ph[Nw * LA:3 * Nw * LA].view(np.int16).reshape(Nw, LA)
-    alen_h = ph[3 * Nw * LA:].view(np.int32)[:Nw]
+    alen_h = ph[3 * Nw * LA:3 * Nw * LA + 4 * Nw].view(np.int32)[:Nw]
+    ovf_h = ph[3 * Nw * LA + 4 * Nw:] != 0
 
-    out_codes: List[bytes] = []
-    out_cov: List[np.ndarray] = []
-    for wi in range(plan.n_win):
+    out_codes: List[Optional[bytes]] = []
+    out_cov: List[Optional[np.ndarray]] = []
+    for wi in range(plan.n_real_win):
+        if ovf_h[wi]:
+            out_codes.append(None)
+            out_cov.append(None)
+            continue
         L = int(alen_h[wi])
         out_codes.append(codes_h[wi, :L].tobytes())
         out_cov.append(cov_h[wi, :L].astype(np.int32))

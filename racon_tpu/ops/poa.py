@@ -136,10 +136,10 @@ class PoaEngine:
         # through the host-orchestrated path whose aligner shards over dp
         # (racon_tpu/parallel/dispatch.py).
         if self.backend == "jax" and self.mesh is None:
-            dev, host = self._partition_device(active)
+            dev, host, lq_max, la_max = self._partition_device(active)
             n = 0
             if dev:
-                n += self._consensus_device(dev)
+                n += self._consensus_device(dev, lq_max, la_max)
             if host:
                 n += self._consensus_host(host, force_native=True)
             return n
@@ -149,55 +149,90 @@ class PoaEngine:
         """Split windows into device-engine vs host-path sets.
 
         The full-width device kernel computes exact NW for any geometry,
-        so everything is device-eligible; only degenerate windows that
-        alone overflow the chunk's dirs-element cap fall back to the
-        host path.
+        so a window falls back to the host path only when (a) it alone
+        overflows the chunk's dirs-element cap, or (b) it is a jumbo
+        outlier (>4x the run's median layer/backbone length) that would
+        inflate the shared run-level padding caps for every chunk.
+
+        Returns (dev, host, dev_lq_max, dev_la_max) — the maxima feed
+        run_caps without a second scan over all layer lists.
         """
         from racon_tpu.ops.device_poa import dir_elems, MAX_DIR_ELEMS
+        lqs = np.array([max(len(d) for d in w.layer_data)
+                        for w in windows])
+        las = np.array([len(w.backbone) for w in windows])
+        lq_lim = 4 * max(float(np.median(lqs)), 1.0)
+        la_lim = 4 * max(float(np.median(las)), 1.0)
         dev, host = [], []
-        for w in windows:
-            lq = max(len(d) for d in w.layer_data)
-            if dir_elems(w.n_layers, lq, len(w.backbone)) > MAX_DIR_ELEMS:
+        lq_max = la_max = 1
+        for w, lq, la in zip(windows, lqs, las):
+            if (dir_elems(w.n_layers, int(lq), int(la)) > MAX_DIR_ELEMS
+                    or lq > lq_lim or la > la_lim):
                 host.append(w)
             else:
                 dev.append(w)
-        return dev, host
+                lq_max = max(lq_max, int(lq))
+                la_max = max(la_max, int(la))
+        return dev, host, lq_max, la_max
 
-    def _consensus_device(self, active: List[Window]) -> int:
+    def _consensus_device(self, active: List[Window], lq_max: int,
+                          la_max: int) -> int:
         """Device-resident path: all refinement rounds on chip, one h2d /
         one d2h per chunk (racon_tpu/ops/device_poa.py)."""
         from racon_tpu.ops.device_poa import (ChunkPlan, run_chunk,
-                                              dir_elems, MAX_DIR_ELEMS)
-        order = sorted(range(len(active)),
-                       key=lambda i: len(active[i].backbone))
+                                              run_caps, _bucket_b,
+                                              MAX_DIR_ELEMS)
+        # One (Lq, LA) cap pair for the whole run (cap-history reuse):
+        # every chunk shares a single compiled device_round executable
+        # instead of paying a multi-second XLA compile per shape.
+        lq_cap, la_cap = run_caps(lq_max, la_max)
+        jobs_cap = self.device_batch
+        while jobs_cap > 128 and \
+                _bucket_b(jobs_cap) * lq_cap * la_cap > MAX_DIR_ELEMS:
+            jobs_cap //= 2
+        if _bucket_b(jobs_cap) * lq_cap * la_cap > MAX_DIR_ELEMS:
+            # Even a minimum-bucket chunk overflows the int32 flat-index
+            # range at these caps (pathological mixed geometry): host path.
+            return self._consensus_host(active, force_native=True)
+        # Windows too wide for any chunk at these caps take the host path
+        # ("not ws" below would otherwise admit them into an over-cap
+        # bucket, wrapping the traceback's int32 flat index).
+        wide = [w for w in active if w.n_layers > jobs_cap]
+        n_wide = 0
+        if wide:
+            active = [w for w in active if w.n_layers <= jobs_cap]
+            n_wide = self._consensus_host(wide, force_native=True)
         i = 0
-        while i < len(order):
+        while i < len(active):
             ws: List[Window] = []
             jobs = 0
-            max_lq = max_la = 1
-            while i < len(order):
-                w = active[order[i]]
-                n_lq = max(max_lq, max(len(d) for d in w.layer_data))
-                n_la = max(max_la, len(w.backbone))
-                n_jobs = jobs + w.n_layers
-                # Dirs tensor must stay under the int32 flat-index cap
-                # (padded dimensions, as ChunkPlan will size them).
-                if ws and (n_jobs > self.device_batch or
-                           dir_elems(n_jobs, n_lq, n_la) > MAX_DIR_ELEMS):
-                    break
-                ws.append(w)
-                jobs, max_lq, max_la = n_jobs, n_lq, n_la
+            while i < len(active) and \
+                    (not ws or jobs + active[i].n_layers <= jobs_cap):
+                ws.append(active[i])
+                jobs += active[i].n_layers
                 i += 1
-            plan = ChunkPlan(ws)
+            plan = ChunkPlan(ws, lq_cap=lq_cap, la_cap=la_cap)
             codes, covs = run_chunk(
                 plan, match=self.match, mismatch=self.mismatch,
                 gap=self.gap, ins_scale=self.ins_scale,
                 rounds=self.refine_rounds + 1)
+            trunc: List[Window] = []
             for w, c, cv in zip(ws, codes, covs):
+                if c is None:
+                    # Consensus outgrew the chunk's padded anchor width
+                    # (sticky device ovf flag): the device result is
+                    # truncated; the host path is unbounded.
+                    trunc.append(w)
+                    continue
                 w.apply_consensus(
                     decode_bases(np.frombuffer(c, dtype=np.uint8)), cv,
                     log=self.log)
-        return len(active)
+            if trunc:
+                print(f"[racon_tpu::PoaEngine] {len(trunc)} window(s) "
+                      "outgrew the device anchor budget; re-polishing on "
+                      "the host path", file=self.log)
+                self._consensus_host(trunc, force_native=True)
+        return len(active) + n_wide
 
     def _consensus_host(self, active: List[Window],
                         force_native: bool = False) -> int:

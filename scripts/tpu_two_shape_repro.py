@@ -1,0 +1,97 @@
+"""Minimal repro harness for the two-executable TPU INVALID_ARGUMENT crash.
+
+Round-3 bench failure (BENCH_r03 rc=1): running the device consensus
+engine at two different padded shapes in one process crashes the second
+run on the real TPU; same shape twice is fine, and small shapes are fine
+(bench 8x8 passes). This script bisects the failure surface:
+
+  python scripts/tpu_two_shape_repro.py engine   # full engine, 2 shapes
+  python scripts/tpu_two_shape_repro.py pallas   # fw_dirs_pallas only
+  python scripts/tpu_two_shape_repro.py xla      # fw_dirs_xla only
+  python scripts/tpu_two_shape_repro.py trace    # fw + traceback, 2 shapes
+
+Shapes mirror the default bench (96 windows x 30 cov): B=2944, LA=768,
+Lq = 544 then 512.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+B, LA = 2944, 768
+LQS = (544, 512)
+
+
+def _consume(x):
+    return float(np.asarray(x.reshape(-1)[:8]).sum())
+
+
+def run_fw(kind: str, with_trace: bool) -> None:
+    import jax.numpy as jnp
+    from racon_tpu.ops import flat as flatmod
+
+    rng = np.random.default_rng(0)
+    for Lq in LQS:
+        tbuf = jnp.asarray(rng.integers(0, 4, (B, LA)).astype(np.uint8))
+        qT = jnp.asarray(rng.integers(0, 4, (Lq, B)).astype(np.uint8))
+        if kind == "pallas":
+            from racon_tpu.ops.pallas.flat_kernel import fw_dirs_pallas
+            dirs = fw_dirs_pallas(tbuf, qT, match=5, mismatch=-4, gap=-8)
+        else:
+            dirs = flatmod.fw_dirs_xla(tbuf, qT, match=5, mismatch=-4,
+                                       gap=-8)
+        if with_trace:
+            lq = jnp.full(B, Lq - 7, jnp.int32)
+            lt = jnp.full(B, LA - 9, jnp.int32)
+            rev = flatmod.fw_traceback(dirs, lq, lt, Lq + LA)
+            print(f"Lq={Lq}: trace ok, sum={_consume(rev)}", flush=True)
+        else:
+            print(f"Lq={Lq}: fw ok, sum={_consume(dirs)}", flush=True)
+
+
+def run_engine() -> None:
+    from bench import build_windows
+    from racon_tpu.ops.poa import PoaEngine
+
+    for seed in (99, 0):
+        eng = PoaEngine(backend="jax")
+        n = eng.consensus_windows(build_windows(96, 30, 500, seed=seed))
+        print(f"seed={seed}: engine ok, {n} windows", flush=True)
+
+
+def run_round() -> None:
+    """Two full run_chunk executions at forced different Lq caps."""
+    from bench import build_windows
+    from racon_tpu.ops.device_poa import ChunkPlan, run_chunk
+
+    windows = build_windows(96, 30, 500, seed=0)
+    for w in windows:
+        w.consensus = None
+    for lq_cap in LQS:
+        plan = ChunkPlan(windows, lq_cap=lq_cap, la_cap=LA)
+        codes, covs = run_chunk(plan, match=5, mismatch=-4, gap=-8,
+                                ins_scale=0.3, rounds=4)
+        print(f"Lq={lq_cap}: round ok, len0={len(codes[0] or b'')}",
+              flush=True)
+
+
+if __name__ == "__main__":
+    mode = sys.argv[1] if len(sys.argv) > 1 else "engine"
+    if mode == "engine":
+        run_engine()
+    elif mode == "round":
+        run_round()
+    elif mode == "pallas":
+        run_fw("pallas", False)
+    elif mode == "xla":
+        run_fw("xla", False)
+    elif mode == "trace":
+        run_fw("pallas", True)
+    elif mode == "trace-xla":
+        run_fw("xla", True)
+    else:
+        raise SystemExit(f"unknown mode {mode}")
+    print("PASS", flush=True)
