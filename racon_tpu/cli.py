@@ -55,9 +55,9 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("-g", "--gap", type=int, default=-8,
                     help="default: -8; gap penalty (must be negative)")
     ap.add_argument("-t", "--threads", type=int, default=1,
-                    help="default: 1; kept for reference CLI compatibility "
-                         "(execution is batched on device/host instead of "
-                         "threaded)")
+                    help="default: 1; OS threads for the native host "
+                         "aligner (<=0 uses all cores); device execution "
+                         "is batched, not threaded")
     ap.add_argument("--backend", choices=["auto", "jax", "native"],
                     default="auto",
                     help="default: auto; alignment backend — 'jax' targets "
@@ -97,7 +97,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             PolisherType.kF if args.fragment_correction else PolisherType.kC,
             args.window_length, args.quality_threshold, args.error_threshold,
             args.match, args.mismatch, args.gap, backend=args.backend,
-            logger=logger)
+            logger=logger, threads=args.threads)
         polisher.initialize()
         polished = polisher.polish(not args.include_unpolished)
     except (PolisherError, ParseError, ValueError) as exc:

@@ -50,8 +50,8 @@ def create_polisher(sequences_path: str, overlaps_path: str,
                     window_length: int = 500, quality_threshold: float = 10.0,
                     error_threshold: float = 0.3, match: int = 5,
                     mismatch: int = -4, gap: int = -8,
-                    backend: str = "auto", logger: Optional[Logger] = None
-                    ) -> "Polisher":
+                    backend: str = "auto", logger: Optional[Logger] = None,
+                    threads: int = 1) -> "Polisher":
     """Validate options and dispatch parsers (src/polisher.cpp:51-130)."""
     if not isinstance(type_, PolisherType):
         raise PolisherError(
@@ -64,7 +64,7 @@ def create_polisher(sequences_path: str, overlaps_path: str,
     tparser = iop.create_sequence_parser(target_path)
     return Polisher(sparser, oparser, tparser, type_, window_length,
                     quality_threshold, error_threshold, match, mismatch,
-                    gap, backend=backend, logger=logger)
+                    gap, backend=backend, logger=logger, threads=threads)
 
 
 class Polisher:
@@ -73,7 +73,7 @@ class Polisher:
                  error_threshold: float, match: int, mismatch: int,
                  gap: int, backend: str = "auto",
                  logger: Optional[Logger] = None,
-                 window_chunk: int = 8192):
+                 window_chunk: int = 8192, threads: int = 1):
         self.sparser = sparser
         self.oparser = oparser
         self.tparser = tparser
@@ -81,7 +81,11 @@ class Polisher:
         self.window_length = window_length
         self.quality_threshold = quality_threshold
         self.error_threshold = error_threshold
-        self.engine = PoaEngine(match, mismatch, gap, backend=backend)
+        # Host-side OS-thread fan-out for the native aligner (reference
+        # -t, src/polisher.cpp:341-364); device batching is unaffected.
+        self.threads = threads
+        self.engine = PoaEngine(match, mismatch, gap, backend=backend,
+                                threads=threads)
         self.logger = logger if logger is not None else NullLogger()
         self.window_chunk = window_chunk
 
@@ -222,7 +226,8 @@ class Polisher:
             from racon_tpu.native.aligner import NativeAligner
             from racon_tpu.ops.cigar import ops_to_cigar
             from racon_tpu.ops.encode import encode_bases
-            aligner = NativeAligner()  # edit-distance scoring, like edlib
+            # Edit-distance scoring, like edlib (src/overlap.cpp:198-200).
+            aligner = NativeAligner(threads=self.threads)
             pairs = []
             for o in pending:
                 q, t = o.alignment_operands(self.sequences)

@@ -40,7 +40,7 @@ def _load():
             ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int64),
             ctypes.POINTER(ctypes.c_int32),
             ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
-            ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_int32,
             ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int64),
             ctypes.POINTER(ctypes.c_int32),
         ]
@@ -62,9 +62,12 @@ class NativeAligner:
     """
 
     def __init__(self, match: int = 0, mismatch: int = -1, gap: int = -1,
-                 band: int = 0):
+                 band: int = 0, threads: int = 1):
         self.match, self.mismatch, self.gap = match, mismatch, gap
         self.band = band
+        # Batch records fan out over OS threads (reference -t semantics,
+        # src/polisher.cpp:341-364); 1 = serial, <=0 = all hardware cores.
+        self.threads = threads
         _load()
 
     def align(self, q: bytes, t: bytes) -> np.ndarray:
@@ -113,7 +116,7 @@ class NativeAligner:
             q_len.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
             _u8ptr(t_flat), t_off.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
             t_len.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-            n, self.match, self.mismatch, self.gap, self.band,
+            n, self.match, self.mismatch, self.gap, self.band, self.threads,
             _u8ptr(ops_out), ops_off.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
             ops_len.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
         if rc != 0:

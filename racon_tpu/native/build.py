@@ -18,7 +18,7 @@ _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "nw.cpp")
 _CXX = os.environ.get("CXX", "g++")
 _FLAGS = ["-O3", "-std=c++17", "-shared", "-fPIC", "-march=native",
-          "-funroll-loops", "-Wall", "-Wextra"]
+          "-funroll-loops", "-Wall", "-Wextra", "-pthread"]
 
 
 class NativeBuildError(RuntimeError):

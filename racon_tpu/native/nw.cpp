@@ -18,9 +18,11 @@
 // Moving to row i+1: diag neighbour keeps b, up neighbour is b+1 in the
 // previous row, left neighbour is b-1 in the same row.
 
+#include <atomic>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
+#include <thread>
 #include <vector>
 #include <algorithm>
 
@@ -32,7 +34,6 @@ enum Dir : uint8_t { kDiag = 0, kUp = 1, kLeft = 2 };
 struct BandResult {
     int32_t n_ops = -1;
     int32_t score = kNegInf;
-    bool touched_edge = false;
 };
 
 // One banded pass. ops_out is filled back-to-front and left in
@@ -42,11 +43,6 @@ BandResult band_pass(const uint8_t* q, int32_t lq, const uint8_t* t,
                      int32_t klo, int32_t khi, uint8_t* ops_out) {
     BandResult res;
     const int32_t bandw = khi - klo + 1;
-    const bool full = (klo <= -lq) && (khi >= lt);
-    // A band side clamped to the matrix boundary is a real edge, not an
-    // artificial cut — touching it must not trigger band doubling.
-    const bool lo_artificial = klo > -lq;
-    const bool hi_artificial = khi < lt;
 
     std::vector<uint8_t> dirs(static_cast<size_t>(lq + 1) * bandw);
     std::vector<int32_t> prev(bandw + 1, kNegInf), cur(bandw + 1, kNegInf);
@@ -122,10 +118,6 @@ BandResult band_pass(const uint8_t* q, int32_t lq, const uint8_t* t,
         } else {
             const int32_t b = j - i - klo;
             if (b < 0 || b >= bandw) return res;  // should not happen
-            if ((lo_artificial && b == 0) ||
-                (hi_artificial && b == bandw - 1)) {
-                res.touched_edge = true;
-            }
             d = dirs[static_cast<size_t>(i) * bandw + b];
         }
         ops_out[--pos] = d;
@@ -136,7 +128,6 @@ BandResult band_pass(const uint8_t* q, int32_t lq, const uint8_t* t,
     if (pos > 0) {
         std::memmove(ops_out, ops_out + pos, res.n_ops);
     }
-    if (full) res.touched_edge = false;
     return res;
 }
 
@@ -164,43 +155,105 @@ int32_t racon_nw_align(const uint8_t* q, int32_t lq, const uint8_t* t,
 
     int32_t w = band0 > 0 ? band0
                           : std::max<int32_t>(128, std::abs(lt - lq) + 64);
+    // The escape bound below needs g < 0 (it divides by -g, and with
+    // free gaps no banded score can ever prove exactness): g >= 0 runs
+    // the full matrix directly.
+    if (g >= 0) w = std::max(lq, lt);
+    // Acceptance is a *provable* escape bound (Ukkonen banding
+    // generalized to match-bonus scoring), not the untouched-edge
+    // heuristic: a balanced long insertion+deletion can route the
+    // optimal path outside the band while a sub-optimal in-band path
+    // never touches the edge (ADVICE r2 #1; edlib is exact).
+    //   Any path leaving the band [min(0,d)-w, max(0,d)+w] (d = lt-lq)
+    //   needs >= |d| + 2(w+1) gap ops (reach the edge + return), and has
+    //   at most min(lq,lt) matches, so it scores at most
+    //     max(m,0)*min(lq,lt) + g*(|d| + 2w + 2).
+    //   A banded score >= that bound therefore beats every escaping
+    //   path, and the in-band DP is exact over in-band paths.
+    // Typical polishing alignments accept on the first pass; the loop
+    // terminates at the full matrix regardless.
+    const int64_t dgap = std::abs(lt - lq);
+    const int64_t mmax = static_cast<int64_t>(std::max(m, 0)) *
+                         std::min(lq, lt);
     while (true) {
         const int32_t klo = std::max(std::min(0, lt - lq) - w, -lq);
         const int32_t khi = std::min(std::max(0, lt - lq) + w, lt);
         BandResult res = band_pass(q, lq, t, lt, m, x, g, klo, khi, ops_out);
-        if (res.n_ops >= 0 && !res.touched_edge) {
-            if (score_out) *score_out = res.score;
-            return res.n_ops;
-        }
         if (klo <= -lq && khi >= lt) {
-            // Full matrix already — result is exact even if edge-marked.
+            // Full matrix — exact.
             if (res.n_ops >= 0) {
                 if (score_out) *score_out = res.score;
                 return res.n_ops;
             }
             return -1;
         }
-        w *= 2;
+        if (res.n_ops >= 0) {
+            const int64_t escape =
+                mmax + static_cast<int64_t>(g) * (dgap + 2 * w + 2);
+            if (static_cast<int64_t>(res.score) >= escape) {
+                if (score_out) *score_out = res.score;
+                return res.n_ops;
+            }
+            // Jump straight to a width whose escape bound the current
+            // (lower-bound) score already beats: the banded score only
+            // improves as the band widens, so the next pass is
+            // guaranteed to accept. Two passes total instead of a
+            // doubling ladder.
+            const int64_t n_g = (mmax - res.score + (-g) - 1) / (-g);
+            const int64_t w_need = (n_g - dgap) / 2 + 1;
+            w = static_cast<int32_t>(
+                std::min<int64_t>(std::max<int64_t>(2 * w, w_need),
+                                  std::max(lq, lt)));
+        } else {
+            w *= 2;
+        }
     }
 }
 
 // Batched form over flat buffers. ops_off[i] must leave q_len[i]+t_len[i]
 // capacity per record; ops_len[i] receives each op count (-1 on failure).
-// Returns 0 on success, first failing index + 1 otherwise.
+// Records fan out over n_threads OS threads (<=0 selects the hardware
+// concurrency), the host analogue of the reference's per-overlap thread
+// pool (src/polisher.cpp:351-364). Returns 0 on success, first failing
+// index + 1 otherwise.
 int32_t racon_nw_align_batch(const uint8_t* q, const int64_t* q_off,
                              const int32_t* q_len, const uint8_t* t,
                              const int64_t* t_off, const int32_t* t_len,
                              int32_t n, int32_t m, int32_t x, int32_t g,
-                             int32_t band0, uint8_t* ops_out,
+                             int32_t band0, int32_t n_threads,
+                             uint8_t* ops_out,
                              const int64_t* ops_off, int32_t* ops_len) {
-    int32_t rc = 0;
-    for (int32_t i = 0; i < n; ++i) {
-        ops_len[i] = racon_nw_align(q + q_off[i], q_len[i], t + t_off[i],
-                                    t_len[i], m, x, g, band0,
-                                    ops_out + ops_off[i], nullptr);
-        if (ops_len[i] < 0 && rc == 0) rc = i + 1;
+    if (n_threads <= 0) {
+        n_threads = static_cast<int32_t>(std::thread::hardware_concurrency());
+        if (n_threads <= 0) n_threads = 1;
     }
-    return rc;
+    n_threads = std::min(n_threads, n);
+    std::atomic<int32_t> next(0), rc(0);
+    auto worker = [&]() {
+        while (true) {
+            const int32_t i = next.fetch_add(1);
+            if (i >= n) return;
+            ops_len[i] = racon_nw_align(q + q_off[i], q_len[i],
+                                        t + t_off[i], t_len[i], m, x, g,
+                                        band0, ops_out + ops_off[i],
+                                        nullptr);
+            if (ops_len[i] < 0) {
+                int32_t cur = rc.load();
+                while ((cur == 0 || i + 1 < cur) &&
+                       !rc.compare_exchange_weak(cur, i + 1)) {
+                }
+            }
+        }
+    };
+    if (n_threads <= 1) {
+        worker();
+    } else {
+        std::vector<std::thread> pool;
+        pool.reserve(n_threads);
+        for (int32_t k = 0; k < n_threads; ++k) pool.emplace_back(worker);
+        for (auto& th : pool) th.join();
+    }
+    return rc.load();
 }
 
 }  // extern "C"

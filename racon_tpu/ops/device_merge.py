@@ -64,7 +64,8 @@ def _take1(a, idx):
     return jnp.take_along_axis(a, jnp.clip(idx, 0, a.shape[1] - 1), axis=1)
 
 
-def extract_votes(ops, q, qw, w_read, lt, t_off, LA: int):
+def extract_votes(ops, q, qw, w_read, lt, t_off, LA: int,
+                  pallas: bool = False):
     """Per-job anchor-aligned dense vote channels from right-aligned ops.
 
     Args:
@@ -75,10 +76,21 @@ def extract_votes(ops, q, qw, w_read, lt, t_off, LA: int):
       lt:     int32[B] target (slice) lengths.
       t_off:  int32[B] slice offset in the window anchor.
       LA:     static anchor padding length.
+      pallas: route the monotone count through the Pallas kernel.
 
     Returns dict of [B, LA(+1), ...] channel arrays (see code).
+
+    Perf notes (measured in-program on TPU v5e at B=3072, S=1408):
+    the broadcast compare-reduce for F cost ~380 ms under XLA — it is a
+    Pallas kernel now (racon_tpu/ops/pallas/count_kernel.py, ~10 ms) —
+    and per-column gathers cost ~10-25 ms *per call* regardless of
+    width, so the ~23 take_along_axis calls of the first version are
+    coalesced into 4 stacked gathers over channel stacks.
     """
+    from racon_tpu.ops.pallas.count_kernel import (monotone_count_pallas,
+                                                   monotone_count_xla)
     B, S = ops.shape
+    Lq = q.shape[1]
     valid = ops != PAD_OP
     tcons = valid & (ops != UP)
     qcons = valid & (ops != LEFT)
@@ -90,37 +102,54 @@ def extract_votes(ops, q, qw, w_read, lt, t_off, LA: int):
     X = jnp.where(valid, ct_excl, -1)
 
     # F[v] = first op index of block v, for v = p - t_off at every anchor
-    # gap/column p in [0, LA]. (+1 row for F[v+1].) searchsorted-left over
-    # a monotone key == count of keys < v; the fused compare-reduce is
-    # ~free on the VPU where jnp.searchsorted's binary-search gathers cost
-    # hundreds of ms at this shape (measured, PROFILE.md).
-    pa = jnp.arange(LA + 2, dtype=jnp.int32)[None, :]
-    vgrid = pa - t_off[:, None]                       # [B, LA+2]
-    F = jnp.sum(X[:, :, None] < vgrid[:, None, :], axis=1,
-                dtype=jnp.int32)                      # [B, LA+2]
+    # gap/column p in [0, LA]. (+1 row for F[v+1].) searchsorted-left
+    # over a monotone key == count of keys < v; shifting X by t_off turns
+    # the per-lane v grid into the plain arange the count kernel wants.
+    Xs = X + t_off[:, None]
+    if pallas and B % 128 == 0:
+        F = monotone_count_pallas(Xs, LA + 2)        # [B, LA+2]
+    else:
+        F = monotone_count_xla(Xs, LA + 2)
     Fa = F[:, :-1]                                    # F(c) at p
     F1 = F[:, 1:]                                     # F(c+1) at p
 
     ltc = lt[:, None]
-    c = vgrid[:, :-1]                                 # slice-rel position at p
+    pa = jnp.arange(LA + 2, dtype=jnp.int32)[None, :]
+    c = (pa - t_off[:, None])[:, :-1]                 # slice-rel position at p
     in_cols = (c >= 0) & (c < ltc)                    # column p exists
     in_gaps = (c >= 0) & (c <= ltc)                   # gap p exists
 
     # Insertion run before column c: block minus its t-step (absent at c==lt).
     ins_len = jnp.where(in_gaps,
                         F1 - Fa - jnp.where(c < ltc, 1, 0), 0)  # [B, LA+1]
-    qstart = _take1(cq_excl, Fa)                      # q idx of first ins base
 
-    # The op consuming column c.
-    s_step = F1 - 1
-    op_at = _take1(ops.astype(jnp.int32), s_step)
-    qi = _take1(cq_excl, s_step)                      # q idx matched at c
+    # Stacked gather #1 (op axis): channels [cq_excl[min(s, S-1)],
+    # cq_excl[s-1], ops[s-1]] read at s = F[p] give, per column, the
+    # first-insertion q index (at p) and the column-consuming op's
+    # q index / op code (at p+1, where F[p+1]-1 is the consumer).
+    # The stack has S+1 rows because F reaches S whenever an alignment's
+    # last op consumes its last column; boundary rows replicate the
+    # clipped-take semantics of a plain gather at F-1 / F.
+    ops32 = ops.astype(jnp.int32)
+    stack_s = jnp.stack(
+        [jnp.concatenate([cq_excl, cq_excl[:, -1:]], axis=1),
+         jnp.concatenate([cq_excl[:, :1], cq_excl], axis=1),
+         jnp.concatenate([ops32[:, :1], ops32], axis=1)],
+        axis=-1)                                      # [B, S+1, 3]
+    G = jnp.take_along_axis(
+        stack_s, jnp.clip(F, 0, S)[:, :, None], axis=1)      # [B, LA+2, 3]
+    qstart = G[:, :-1, 0]                             # q idx of first ins base
+    qi = G[:, 1:, 1]                                  # q idx matched at c
+    op_at = G[:, 1:, 2]                               # op consuming column c
     is_match = in_cols & (op_at == DIAG)
-    is_del = in_cols & (op_at == LEFT)
 
+    # Stacked gather #2 (query axis) at qi: [base code, weight].
     qx = q.astype(jnp.int32)
-    colbase = _take1(qx, qi)
-    colw = _take1(qw, qi)
+    stack_qi = jnp.stack([qx.astype(jnp.float32), qw], axis=-1)
+    Gqi = jnp.take_along_axis(
+        stack_qi, jnp.clip(qi, 0, Lq - 1)[:, :, None], axis=1)
+    colbase = Gqi[..., 0].astype(jnp.int32)
+    colw = Gqi[..., 1]
     wq = jnp.where(is_match, colw, w_read[:, None])   # per-column path weight
 
     cols = in_cols[:, :LA]
@@ -137,32 +166,51 @@ def extract_votes(ops, q, qw, w_read, lt, t_off, LA: int):
     wq_prev = jnp.concatenate([w_read[:, None], wq[:, :LA]], axis=1)
     cross_w = jnp.where(crossed, 0.5 * (wq_prev + wq), 0.0)    # [B, LA+1]
 
+    # Stacked gather #3 (query axis) at qstart: the k = 0..K-1 shifted
+    # base/weight channels (pileup columns without per-k gathers) plus
+    # the weight prefix sum at the run start. Tail-clamped pads replicate
+    # take-with-clip semantics for runs ending at the query edge.
+    qwcum = jnp.concatenate(
+        [jnp.zeros((B, 1), jnp.float32), jnp.cumsum(qw, axis=1)], axis=1)
+    qx_pad = jnp.concatenate(
+        [qx, jnp.repeat(qx[:, -1:], K_INS - 1, axis=1)], axis=1)
+    qw_pad = jnp.concatenate(
+        [qw, jnp.repeat(qw[:, -1:], K_INS - 1, axis=1)], axis=1)
+    chans = ([qx_pad[:, k:k + Lq].astype(jnp.float32)
+              for k in range(K_INS)] +
+             [qw_pad[:, k:k + Lq] for k in range(K_INS)] +
+             [qwcum[:, :Lq]])
+    stack_qs = jnp.stack(chans, axis=-1)              # [B, Lq, 2K+1]
+    Gqs = jnp.take_along_axis(
+        stack_qs, jnp.clip(qstart, 0, Lq - 1)[:, :, None], axis=1)
+    b_k = Gqs[..., :K_INS].astype(jnp.int32)          # q[qstart+k]
+    w_k = Gqs[..., K_INS:2 * K_INS]                   # qw[qstart+k]
+    cum_start = Gqs[..., 2 * K_INS]                   # qwcum[qstart]
+
     # Insertions.
     has1 = in_gaps & (ins_len == 1)
     multi = in_gaps & (ins_len >= 2)
-    b1 = _take1(qx, qstart)
-    w1 = _take1(qw, qstart)
+    b1 = b_k[..., 0]
+    w1 = w_k[..., 0]
     ins1_oh = _onehot(jnp.where(has1, b1, NBASE), NBASE + 1)[..., :NBASE]
     ins1_w_ch = ins1_oh * jnp.where(has1, w1, 0.0)[..., None]
     ins1_c_ch = ins1_oh * has1[..., None]
     ins1_stop = jnp.where(has1, w1, 0.0)
 
-    # Pileup columns k = 0..K-1 for multi-base runs.
+    # Pileup columns k = 0..K-1 for multi-base runs (no gathers).
     pk_w, pk_c = [], []
     for k in range(K_INS):
         inrun = multi & (ins_len > k)
-        bk = _take1(qx, qstart + k)
-        wk = _take1(qw, qstart + k)
-        oh = _onehot(jnp.where(inrun, bk, NBASE), NBASE + 1)[..., :NBASE]
-        pk_w.append(oh * jnp.where(inrun, wk, 0.0)[..., None])
+        oh = _onehot(jnp.where(inrun, b_k[..., k], NBASE),
+                     NBASE + 1)[..., :NBASE]
+        pk_w.append(oh * jnp.where(inrun, w_k[..., k], 0.0)[..., None])
         pk_c.append(oh * inrun[..., None])
     pile_w_ch = jnp.stack(pk_w, axis=2)               # [B, LA+1, K, 5]
     pile_c_ch = jnp.stack(pk_c, axis=2)
 
     # Run mean weight -> stop-weight by run length (lengths 2..K).
-    qwcum = jnp.concatenate(
-        [jnp.zeros((B, 1), jnp.float32), jnp.cumsum(qw, axis=1)], axis=1)
-    run_sum = _take1(qwcum, qstart + ins_len) - _take1(qwcum, qstart)
+    # Stacked gather #4: weight prefix sum at the run end.
+    run_sum = _take1(qwcum, qstart + ins_len) - cum_start
     wmean = jnp.where(multi, run_sum / jnp.maximum(ins_len, 1), 0.0)
     lw_oh = (jnp.clip(ins_len, 0, K_INS)[..., None] ==
              jnp.arange(2, K_INS + 1)[None, None, :])
@@ -176,7 +224,6 @@ def extract_votes(ops, q, qw, w_read, lt, t_off, LA: int):
         "pile_w": pile_w_ch.reshape(B, LA + 1, -1),
         "pile_c": pile_c_ch.reshape(B, LA + 1, -1),
         "lenw": lenw_ch,
-        "is_del": is_del,  # unused downstream; kept for debugging
     }
 
 

@@ -64,7 +64,11 @@ _CAP_HISTORY: set = set()
 def run_caps(lq: int, la: int) -> Tuple[int, int]:
     """(lq_cap, la_cap) covering a run's max layer/backbone lengths, on a
     coarse grid."""
-    need = (_round_up(lq, 128), _round_up(la + LA_GROW, 128))
+    # LA pads on a 256 grid: backbone lengths cluster at the window
+    # length (~w..w+6%), and a 128 grid put typical runs right on a
+    # bucket boundary — two runs of the same workload (e.g. bench warmup
+    # vs measured) landed in different buckets and recompiled.
+    need = (_round_up(lq, 128), _round_up(la + LA_GROW, 256))
     if 128 * need[0] * need[1] > MAX_DIR_ELEMS:
         # Unusable even at the minimum batch bucket (caller falls back to
         # the host path) — don't record it, or it would shadow smaller
@@ -228,7 +232,8 @@ def device_round(bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf, *,
     ops = jnp.flip(rev, axis=1)
 
     qw = jnp.maximum(qw8.astype(jnp.float32) - 1.0, 0.0)
-    votes = dm.extract_votes(ops, q, qw, w_read, lt, t_off, LA)
+    votes = dm.extract_votes(ops, q, qw, w_read, lt, t_off, LA,
+                             pallas=pallas)
     acc = dm.aggregate_votes(votes, win, n_win + 1)
     acc = {k: v[:-1] for k, v in acc.items()}       # drop padded-lane row
     acc = dm.add_backbone(acc, bb[:-1], bbw[:-1], alen[:-1])
@@ -273,7 +278,7 @@ def _pack_out(codes, cov, alen, ovf):
 
 
 def run_chunk(plan: ChunkPlan, *, match: int, mismatch: int, gap: int,
-              ins_scale: float, rounds: int
+              ins_scale: float, rounds: int, stats: Optional[dict] = None
               ) -> Tuple[List[Optional[bytes]], List[Optional[np.ndarray]]]:
     """Execute all refinement rounds for a chunk; one h2d, one d2h.
 
@@ -281,28 +286,63 @@ def run_chunk(plan: ChunkPlan, *, match: int, mismatch: int, gap: int,
     whose consensus outgrew the padded anchor width (sticky ``ovf`` flag)
     yields ``None`` in both lists — the caller must re-run it on the
     unbounded host path instead of shipping a silently truncated string.
+
+    ``stats`` (optional dict) accumulates phase wall times under keys
+    "h2d" / "compute" / "d2h" / "chunks". Phase edges force a tiny d2h
+    (jax.block_until_ready is a no-op on the axon backend), so collecting
+    stats adds two tunnel round-trips per chunk; production runs pass
+    None and pay nothing. RACON_TPU_TIMING=1 additionally prints each
+    refinement round's time to stderr.
     """
+    import os
+    import sys
+    import time
     import jax
     import jax.numpy as jnp
 
+    verbose = os.environ.get("RACON_TPU_TIMING", "") not in ("", "0")
+    collect = stats is not None or verbose
+
+    def sync(x, tag, t0):
+        np.asarray(jnp.ravel(x)[:1])
+        dt = time.perf_counter() - t0
+        if verbose:
+            print(f"[racon_tpu::run_chunk] {tag}: {dt:.3f}s",
+                  file=sys.stderr, flush=True)
+        if stats is not None:
+            key = tag.split("/")[0]
+            stats[key] = stats.get(key, 0.0) + dt
+        return time.perf_counter()
+
     pallas = _use_pallas(plan.B, plan.Lq, plan.LA)
+    t0 = time.perf_counter()
     dev_args = jax.device_put((plan.bb, plan.bbw, plan.alen, plan.begin,
                                plan.end, plan.q, plan.qw8, plan.lq,
                                plan.w_read, plan.win))
     bb, bbw, alen, begin, end, q, qw8, lq, w_read, win = dev_args
+    if collect:
+        t0 = sync(alen, "h2d", t0)
     cov = None
     ovf = jnp.zeros(plan.n_win, dtype=bool)
-    for _ in range(rounds):
+    for r in range(rounds):
         bb, bbw, alen, begin, end, cov, ovf = device_round(
             bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf,
             match=match, mismatch=mismatch, gap=gap, ins_scale=ins_scale,
             Lq=plan.Lq, steps=plan.steps, n_win=plan.n_win,
             LA=plan.LA, pallas=pallas)
+        if verbose:
+            t0 = sync(cov, f"compute/round{r}", t0)
+    if collect and not verbose:
+        t0 = sync(cov, "compute", t0)
+    if stats is not None:
+        stats["chunks"] = stats.get("chunks", 0) + 1
 
     # One synchronized pull: everything packed into a single uint8 buffer.
     Nw, LA = plan.n_win, plan.LA
     packed = _pack_out(bb[:-1], cov, alen[:-1], ovf)
     ph = np.asarray(packed)
+    if collect:
+        t0 = sync(packed, "d2h", t0)
     codes_h = ph[:Nw * LA].reshape(Nw, LA)
     cov_h = ph[Nw * LA:3 * Nw * LA].view(np.int16).reshape(Nw, LA)
     alen_h = ph[3 * Nw * LA:3 * Nw * LA + 4 * Nw].view(np.int32)[:Nw]

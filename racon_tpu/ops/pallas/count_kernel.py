@@ -1,0 +1,77 @@
+"""Pallas TPU kernel for the monotone counting step of the device merge:
+
+    F[b, p] = #{ s : X[b, s] < p }   for p in [0, P)
+
+This is extract_votes' searchsorted-left over the per-lane monotone block
+key (racon_tpu/ops/device_merge.py) — the replacement for spoa's
+aligned-node bookkeeping. XLA lowers the equivalent broadcast
+compare-reduce to ~380 ms of VPU time at bench shapes (B=3072, S=1408,
+P=770, measured in-program); this kernel streams X once through VMEM and
+keeps the [8, 128] accumulator in registers, hitting the VPU's native
+throughput instead.
+
+Layout: X arrives transposed [S, B] so the per-step row read is a cheap
+dynamic *sublane* slice; p values sit on sublanes, jobs on lanes. Output
+is [P, B] (the caller transposes back — one XLA transpose of a few MB).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+PB = 8     # p values per program (sublanes)
+TB = 128   # jobs per program (lanes)
+
+
+def _kernel(XT_ref, out_ref, *, S):
+    p = pl.program_id(0)
+    pvals = p * PB + jax.lax.broadcasted_iota(jnp.int32, (PB, TB), 0)
+
+    def body(s, acc):
+        row = XT_ref[s]                       # [TB] int32 (sublane slice)
+        return acc + jnp.where(row[None, :] < pvals, 1, 0)
+
+    out_ref[...] = jax.lax.fori_loop(
+        0, S, body, jnp.zeros((PB, TB), jnp.int32))
+
+
+def _round_up(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+@functools.partial(jax.jit, static_argnames=("P",))
+def monotone_count_pallas(X: jnp.ndarray, P: int) -> jnp.ndarray:
+    """F[b, p] = sum_s (X[b, s] < p), int32[B, P].
+
+    B must be a multiple of 128. Monotonicity of X is not actually
+    required by the counting itself — only by callers interpreting F as
+    a searchsorted result.
+    """
+    B, S = X.shape
+    Pp = _round_up(P, PB)
+    XT = X.T                                   # [S, B]
+    kernel = functools.partial(_kernel, S=S)
+    outT = pl.pallas_call(
+        kernel,
+        grid=(Pp // PB, B // TB),
+        in_specs=[pl.BlockSpec((S, TB), lambda p, b: (0, b),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((PB, TB), lambda p, b: (p, b),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((Pp, B), jnp.int32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")),
+    )(XT)
+    return outT[:P].T
+
+
+def monotone_count_xla(X: jnp.ndarray, P: int) -> jnp.ndarray:
+    """Reference/fallback form (CPU tests, non-aligned shapes)."""
+    pa = jnp.arange(P, dtype=jnp.int32)
+    return jnp.sum(X[:, :, None] < pa[None, None, :], axis=1,
+                   dtype=jnp.int32)

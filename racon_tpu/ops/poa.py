@@ -93,7 +93,7 @@ class PoaEngine:
     def __init__(self, match: int = 5, mismatch: int = -4, gap: int = -8,
                  backend: str = "auto", device_batch: int = 4096,
                  refine_rounds: int = 3, ins_scale: float = 0.3,
-                 mesh=None, log=sys.stderr):
+                 mesh=None, log=sys.stderr, threads: int = 1):
         if gap >= 0:
             raise ValueError(
                 "[racon_tpu::PoaEngine] error: gap penalty must be negative!")
@@ -114,6 +114,11 @@ class PoaEngine:
         # Optional jax.sharding.Mesh: alignment batches shard over its
         # "dp" axis (racon_tpu/parallel/dispatch.py).
         self.mesh = mesh
+        # OS threads for the native host aligner (reference -t).
+        self.threads = threads
+        # Optional dict: run_chunk accumulates phase wall times into it
+        # ("h2d"/"compute"/"d2h"/"chunks"); None = no timing syncs.
+        self.stats = None
         self._native = None
 
     # ------------------------------------------------------------ public API
@@ -202,12 +207,18 @@ class PoaEngine:
         if wide:
             active = [w for w in active if w.n_layers <= jobs_cap]
             n_wide = self._consensus_host(wide, force_native=True)
+        # Balance jobs across the minimum number of chunks: equal-size
+        # chunks land in one B bucket (one compiled executable) where a
+        # greedy full-then-remainder split would produce two.
+        total_jobs = sum(w.n_layers for w in active)
+        n_chunks = max(1, -(-total_jobs // jobs_cap))
+        target = -(-total_jobs // n_chunks)
         i = 0
         while i < len(active):
             ws: List[Window] = []
             jobs = 0
             while i < len(active) and \
-                    (not ws or jobs + active[i].n_layers <= jobs_cap):
+                    (not ws or jobs + active[i].n_layers <= target):
                 ws.append(active[i])
                 jobs += active[i].n_layers
                 i += 1
@@ -215,7 +226,7 @@ class PoaEngine:
             codes, covs = run_chunk(
                 plan, match=self.match, mismatch=self.mismatch,
                 gap=self.gap, ins_scale=self.ins_scale,
-                rounds=self.refine_rounds + 1)
+                rounds=self.refine_rounds + 1, stats=self.stats)
             trunc: List[Window] = []
             for w, c, cv in zip(ws, codes, covs):
                 if c is None:
@@ -317,7 +328,8 @@ class PoaEngine:
     def _align_native(self, jobs: List[_Job]) -> None:
         from racon_tpu.native.aligner import NativeAligner
         if self._native is None:
-            self._native = NativeAligner(self.match, self.mismatch, self.gap)
+            self._native = NativeAligner(self.match, self.mismatch,
+                                         self.gap, threads=self.threads)
         pairs = [(j.q, j.t) for j in jobs]
         for j, ops in zip(jobs, self._native.align_batch(pairs)):
             j.ops = ops

@@ -97,7 +97,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                     else PolisherType.kC,
                     args.window_length, args.quality_threshold,
                     args.error_threshold, args.match, args.mismatch,
-                    args.gap, backend=args.backend)
+                    args.gap, backend=args.backend, threads=args.threads)
                 polisher.initialize()
                 polished = polisher.polish(not args.include_unpolished)
                 tmp = chunk_out + ".tmp"

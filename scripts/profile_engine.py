@@ -1,0 +1,112 @@
+"""Stage-by-stage wall profile of the device consensus engine on the
+current backend (real TPU under axon; CPU with jax_platforms=cpu).
+
+Times, at bench shapes, each piece of device_round in isolation by
+jitting progressively larger prefixes of the round and blocking on a
+scalar consume of the result. Prints one line per stage.
+
+Usage: python scripts/profile_engine.py [n_windows] [coverage]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def t(fn, *args, reps=2, **kw):
+    out = np.asarray(fn(*args, **kw))   # compile + force d2h
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = np.asarray(fn(*args, **kw))
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import functools
+    from bench import build_windows
+    from racon_tpu.ops.device_poa import ChunkPlan, run_caps, _use_pallas
+    from racon_tpu.ops import device_merge as dm
+    from racon_tpu.ops import flat as flatmod
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 96
+    cov = int(sys.argv[2]) if len(sys.argv) > 2 else 30
+    windows = build_windows(n, cov, 500, seed=0)
+    lq = max(max(len(d) for d in w.layer_data) for w in windows)
+    la = max(len(w.backbone) for w in windows)
+    lq_cap, la_cap = run_caps(lq, la)
+    plan = ChunkPlan(windows, lq_cap=lq_cap, la_cap=la_cap)
+    print(f"backend={jax.default_backend()} B={plan.B} Lq={plan.Lq} "
+          f"LA={plan.LA} n_win={plan.n_win} steps={plan.steps}",
+        flush=True)
+    M, X, G, INS = 5, -4, -8, 0.3
+
+    t0 = time.perf_counter()
+    dev = jax.device_put((plan.bb, plan.bbw, plan.alen, plan.begin,
+                          plan.end, plan.q, plan.qw8, plan.lq,
+                          plan.w_read, plan.win))
+    jax.block_until_ready(dev)
+    bb, bbw, alen, begin, end, q, qw8, lqv, w_read, win = dev
+    print(f"h2d: {time.perf_counter() - t0:.3f}s", flush=True)
+
+    pallas = _use_pallas(plan.B, plan.Lq, plan.LA)
+    LA, Lq, steps, n_win = plan.LA, plan.Lq, plan.steps, plan.n_win
+
+    @functools.partial(jax.jit, static_argnames=("upto",))
+    def stage(bb, bbw, alen, begin, end, q, qw8, lqv, w_read, win, *,
+              upto):
+        L = jnp.take(alen, win)
+        b_c = jnp.clip(begin, 0, L - 1)
+        e_c = jnp.clip(end, b_c, L - 1)
+        offs = L // 100
+        full = (b_c < offs) & (e_c > L - offs)
+        t_off = jnp.where(full, 0, b_c).astype(jnp.int32)
+        lt = jnp.where(full, L, e_c - b_c + 1).astype(jnp.int32)
+        x = jnp.arange(LA, dtype=jnp.int32)[None, :]
+        ok = x < lt[:, None]
+        flat = bb.reshape(-1)
+        gidx = (win[:, None] * LA + jnp.clip(t_off[:, None] + x, 0, LA - 1))
+        tbuf = jnp.where(ok, jnp.take(flat, gidx), 7).astype(jnp.uint8)
+        if pallas:
+            from racon_tpu.ops.pallas.flat_kernel import fw_dirs_pallas
+            dirs = fw_dirs_pallas(tbuf, q.T, match=M, mismatch=X, gap=G)
+        else:
+            dirs = flatmod.fw_dirs_xla(tbuf, q.T, match=M, mismatch=X,
+                                       gap=G)
+        if upto == "fw":
+            return jnp.sum(dirs, dtype=jnp.int32)
+        rev = flatmod.fw_traceback(dirs, lqv, lt, steps)
+        ops = jnp.flip(rev, axis=1)
+        if upto == "tb":
+            return jnp.sum(ops, dtype=jnp.int32)
+        qw = jnp.maximum(qw8.astype(jnp.float32) - 1.0, 0.0)
+        votes = dm.extract_votes(ops, q, qw, w_read, lt, t_off, LA,
+                                 pallas=pallas)
+        if upto == "votes":
+            return sum(jnp.sum(v) for v in votes.values())
+        acc = dm.aggregate_votes(votes, win, n_win + 1)
+        if upto == "agg":
+            return sum(jnp.sum(v) for v in acc.values())
+        acc = {k: v[:-1] for k, v in acc.items()}
+        acc = dm.add_backbone(acc, bb[:-1], bbw[:-1], alen[:-1])
+        asm = dm.assemble(acc, alen[:-1], INS)
+        codes, cov_, total = dm.compact(asm, LA)
+        map_b, map_e = dm.coord_maps(asm, alen[:-1], LA)
+        return (jnp.sum(codes, dtype=jnp.int32) + jnp.sum(total) +
+                jnp.sum(map_b) + jnp.sum(map_e) + jnp.sum(cov_))
+
+    args = (bb, bbw, alen, begin, end, q, qw8, lqv, w_read, win)
+    prev = 0.0
+    for upto in ("fw", "tb", "votes", "agg", "all"):
+        dt = t(stage, *args, upto=upto)
+        print(f"{upto:6s}: {dt:.3f}s (+{dt - prev:.3f}s)", flush=True)
+        prev = dt
+
+
+if __name__ == "__main__":
+    main()

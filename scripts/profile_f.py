@@ -1,0 +1,77 @@
+"""Micro-bench: variants of the monotone counting step
+F[b,v] = #{s : X[b,s] < v} that dominates extract_votes.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+B, S, P = 2048, 1408, 770
+
+
+def t(fn, *args, reps=3):
+    out = np.asarray(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = np.asarray(fn(*args))
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    Xh = np.sort(rng.integers(-1, P, (B, S)), axis=1).astype(np.int32)
+    X = jnp.asarray(Xh)
+    vg = jnp.asarray(np.tile(np.arange(P, dtype=np.int32), (B, 1)))
+
+    @jax.jit
+    def f_mid(X, vg):                      # current form (sum over axis 1)
+        return jnp.sum(X[:, :, None] < vg[:, None, :], axis=1,
+                       dtype=jnp.int32)
+
+    @jax.jit
+    def f_last(X, vg):                     # reduce over the lane axis
+        return jnp.sum(X[:, None, :] < vg[:, :, None], axis=2,
+                       dtype=jnp.int32)
+
+    @jax.jit
+    def f_mm(X, vg):                       # MXU: ones @ compare (bf16)
+        cmp = (X[:, :, None] < vg[:, None, :]).astype(jnp.bfloat16)
+        ones = jnp.ones((B, S), jnp.bfloat16)
+        return jnp.einsum("bs,bsp->bp", ones, cmp).astype(jnp.int32)
+
+    @jax.jit
+    def f_two(X, vg):                      # two-level monotone blocks
+        K = 128
+        nb = S // K
+        Xb = X.reshape(B, nb, K)
+        last = Xb[:, :, -1]                           # block max
+        coarse = jnp.sum(last[:, :, None] < vg[:, None, :], axis=1,
+                         dtype=jnp.int32)             # full blocks
+        kstar = jnp.clip(coarse, 0, nb - 1)
+        blk = jnp.take_along_axis(Xb, kstar[:, :, None], axis=1)  # [B,P,K]
+        fine = jnp.sum(blk < vg[:, :, None], axis=2, dtype=jnp.int32)
+        # Blocks before kstar are entirely < v; kstar's partial count adds
+        # fine (when coarse == nb, kstar = nb-1 and fine = K, so F = S).
+        return kstar * K + fine
+
+    # correctness vs numpy
+    ref = (Xh[:, :, None] < np.arange(P)[None, None, :]).sum(1)
+    outs = {}
+    for name, fn in (("mid", f_mid), ("last", f_last), ("mm", f_mm),
+                     ("two", f_two)):
+        o = np.asarray(fn(X, vg))
+        outs[name] = o
+        ok = np.array_equal(o, ref)
+        dt = t(fn, X, vg)
+        print(f"{name:5s}: {dt*1e3:7.1f} ms  correct={ok}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,86 @@
+"""Micro-bench: TPU gather cost shapes the extract_votes redesign.
+
+Times (a) the monotone compare-reduce (F tensor), (b) one
+take_along_axis gather [B,P] <- [B,S], (c) a stacked gather
+[B,P,C] <- [B,S,C], (d) C separate gathers — to learn whether gather
+cost is per-call or per-element on this stack.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+B, S, P, C = 2048, 1408, 770, 8
+
+
+def t(fn, *args, reps=3):
+    out = np.asarray(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = np.asarray(fn(*args))
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    X = jnp.asarray(np.sort(rng.integers(-1, P, (B, S)), axis=1)
+                    .astype(np.int32))
+    vg = jnp.asarray(np.tile(np.arange(P, dtype=np.int32), (B, 1)))
+    a = jnp.asarray(rng.random((B, S)).astype(np.float32))
+    aC = jnp.asarray(rng.random((B, S, C)).astype(np.float32))
+    idx = jnp.asarray(rng.integers(0, S, (B, P)).astype(np.int32))
+    idx_mono = jnp.asarray(np.sort(rng.integers(0, S, (B, P)), axis=1)
+                           .astype(np.int32))
+
+    @jax.jit
+    def f_compare(X, vg):
+        return jnp.sum(X[:, :, None] < vg[:, None, :], axis=1,
+                       dtype=jnp.int32)
+
+    @jax.jit
+    def g_one(a, idx):
+        return jnp.sum(jnp.take_along_axis(a, idx, axis=1))
+
+    @jax.jit
+    def g_stack(aC, idx):
+        out = jnp.take_along_axis(aC, idx[:, :, None], axis=1)
+        return jnp.sum(out)
+
+    @jax.jit
+    def g_sep(aC, idx):
+        s = 0.0
+        for c in range(C):
+            s += jnp.sum(jnp.take_along_axis(aC[:, :, c], idx, axis=1))
+        return s
+
+    @jax.jit
+    def g_onehot_mm(a, vg, X):
+        oh = (X[:, :, None] == vg[:, None, :]).astype(jnp.bfloat16)
+        return jnp.sum(jnp.einsum("bs,bsp->bp", a.astype(jnp.bfloat16),
+                                  oh, precision=jax.lax.Precision.DEFAULT))
+
+    print(f"backend={jax.default_backend()} B={B} S={S} P={P} C={C}",
+          flush=True)
+    print(f"compare-reduce F [B,S,P]: {t(f_compare, X, vg)*1e3:.1f} ms",
+          flush=True)
+    print(f"gather x1   [B,P]<-[B,S]: {t(g_one, a, idx)*1e3:.1f} ms",
+          flush=True)
+    print(f"gather x1 monotone idx  : {t(g_one, a, idx_mono)*1e3:.1f} ms",
+          flush=True)
+    print(f"gather stacked [B,P,{C}] : {t(g_stack, aC, idx)*1e3:.1f} ms",
+          flush=True)
+    print(f"gather separate x{C}     : {t(g_sep, aC, idx)*1e3:.1f} ms",
+          flush=True)
+    print(f"onehot-matmul alternative: {t(g_onehot_mm, a, vg, X)*1e3:.1f} ms",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()
