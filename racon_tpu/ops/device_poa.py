@@ -103,7 +103,8 @@ class ChunkPlan:
     """
 
     def __init__(self, windows: List[Window], la_grow: int = LA_GROW,
-                 lq_cap: Optional[int] = None, la_cap: Optional[int] = None):
+                 lq_cap: Optional[int] = None, la_cap: Optional[int] = None,
+                 n_shards: int = 1):
         self.windows = windows
         jobs_q: List[np.ndarray] = []
         jobs_w: List[np.ndarray] = []
@@ -126,7 +127,8 @@ class ChunkPlan:
         self.n_real_win = len(windows)
         self.n_win = _round_up(len(windows), 32)
         self.n_jobs = len(jobs_q)
-        B = _bucket_b(self.n_jobs)
+        # Each mesh shard needs a 128-lane-aligned slice of the job axis.
+        B = _round_up(_bucket_b(self.n_jobs), 128 * n_shards)
         max_lq = max(len(q) for q in jobs_q)
         LA0 = max(len(a) for a in anchors)
         Lq = lq_cap if lq_cap is not None else _round_up(max_lq, 128)
@@ -182,19 +184,21 @@ def _use_pallas(B: int, Lq: int, LA: int) -> bool:
     return B % TB == 0 and Lq % CH == 0 and LA % 128 == 0
 
 
-@functools.partial(
-    __import__("jax").jit,
-    static_argnames=("match", "mismatch", "gap", "ins_scale", "Lq", "steps",
-                     "n_win", "LA", "pallas"))
-def device_round(bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf, *,
-                 match, mismatch, gap, ins_scale, Lq, steps, n_win,
-                 LA, pallas):
-    """One alignment + merge round, fully on device.
+def _round_core(bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf, *,
+                match, mismatch, gap, ins_scale, Lq, steps, n_win,
+                LA, pallas, axis_name=None):
+    """One alignment + merge round (traced body, single shard's view).
 
     Returns (new_bb, new_bbw, new_alen, new_begin, new_end, cov, ovf).
     ``ovf`` is a sticky per-window flag: consensus outgrew the padded
     anchor width this round (or any earlier one) and was truncated —
     the host must re-run those windows (the host path is unbounded).
+
+    Under shard_map the job (B) axis is sharded over ``axis_name`` while
+    window arrays are replicated; the only collective is one psum of the
+    per-window vote accumulators (jobs of one window may live on any
+    shard) — windows are otherwise independent, matching the reference's
+    per-window fan-out (src/polisher.cpp:457-469).
     """
     import jax
     import jax.numpy as jnp
@@ -235,6 +239,8 @@ def device_round(bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf, *,
     votes = dm.extract_votes(ops, q, qw, w_read, lt, t_off, LA,
                              pallas=pallas)
     acc = dm.aggregate_votes(votes, win, n_win + 1)
+    if axis_name is not None:
+        acc = {k: jax.lax.psum(v, axis_name) for k, v in acc.items()}
     acc = {k: v[:-1] for k, v in acc.items()}       # drop padded-lane row
     acc = dm.add_backbone(acc, bb[:-1], bbw[:-1], alen[:-1])
     asm = dm.assemble(acc, alen[:-1], ins_scale)
@@ -260,6 +266,46 @@ def device_round(bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf, *,
     return new_bb, new_bbw, new_alen, nb, ne, cov, ovf
 
 
+device_round = functools.partial(
+    __import__("jax").jit,
+    static_argnames=("match", "mismatch", "gap", "ins_scale", "Lq", "steps",
+                     "n_win", "LA", "pallas"))(_round_core)
+
+
+@functools.partial(
+    __import__("jax").jit,
+    static_argnames=("match", "mismatch", "gap", "ins_scale", "Lq", "steps",
+                     "n_win", "LA", "pallas", "mesh"))
+def device_round_sharded(bb, bbw, alen, begin, end, q, qw8, lq, w_read,
+                         win, ovf, *, match, mismatch, gap, ins_scale, Lq,
+                         steps, n_win, LA, pallas, mesh):
+    """device_round with the job axis sharded over the mesh's "dp" axis.
+
+    Window arrays (anchors, lengths, ovf) stay replicated; each chip
+    aligns and votes its job shard, one psum merges the per-window
+    accumulators, and the (replicated) assembly/compaction runs
+    redundantly per chip — zero-collective except that psum, as windows
+    are independent (SURVEY.md section 7 step 6)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    core = functools.partial(
+        _round_core, match=match, mismatch=mismatch, gap=gap,
+        ins_scale=ins_scale, Lq=Lq, steps=steps, n_win=n_win, LA=LA,
+        pallas=pallas, axis_name="dp")
+    rep = P()
+    job = P("dp")
+    # check_vma=False: the Pallas kernels' out_shapes carry no varying-
+    # mesh-axes annotation, which the checker (TPU path only) rejects;
+    # the in/out specs above state the sharding contract explicitly.
+    fn = jax.shard_map(
+        core, mesh=mesh,
+        in_specs=(rep, rep, rep, job, job, job, job, job, job, job, rep),
+        out_specs=(rep, rep, rep, job, job, rep, rep),
+        check_vma=False)
+    return fn(bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf)
+
+
 @functools.partial(__import__("jax").jit)
 def _pack_out(codes, cov, alen, ovf):
     """Flatten codes/cov/lengths/overflow into one uint8 buffer for a
@@ -278,7 +324,8 @@ def _pack_out(codes, cov, alen, ovf):
 
 
 def run_chunk(plan: ChunkPlan, *, match: int, mismatch: int, gap: int,
-              ins_scale: float, rounds: int, stats: Optional[dict] = None
+              ins_scale: float, rounds: int, stats: Optional[dict] = None,
+              mesh=None
               ) -> Tuple[List[Optional[bytes]], List[Optional[np.ndarray]]]:
     """Execute all refinement rounds for a chunk; one h2d, one d2h.
 
@@ -314,18 +361,29 @@ def run_chunk(plan: ChunkPlan, *, match: int, mismatch: int, gap: int,
             stats[key] = stats.get(key, 0.0) + dt
         return time.perf_counter()
 
-    pallas = _use_pallas(plan.B, plan.Lq, plan.LA)
+    ndp = mesh.shape["dp"] if mesh is not None else 1
+    pallas = _use_pallas(plan.B // ndp, plan.Lq, plan.LA)
     t0 = time.perf_counter()
-    dev_args = jax.device_put((plan.bb, plan.bbw, plan.alen, plan.begin,
-                               plan.end, plan.q, plan.qw8, plan.lq,
-                               plan.w_read, plan.win))
+    host_args = (plan.bb, plan.bbw, plan.alen, plan.begin, plan.end,
+                 plan.q, plan.qw8, plan.lq, plan.w_read, plan.win)
+    if mesh is None:
+        rnd = device_round
+        dev_args = jax.device_put(host_args)
+    else:
+        from jax.sharding import NamedSharding, PartitionSpec
+        rnd = functools.partial(device_round_sharded, mesh=mesh)
+        rep = NamedSharding(mesh, PartitionSpec())
+        job = NamedSharding(mesh, PartitionSpec("dp"))
+        shardings = (rep, rep, rep, job, job, job, job, job, job, job)
+        dev_args = tuple(jax.device_put(a, s)
+                         for a, s in zip(host_args, shardings))
     bb, bbw, alen, begin, end, q, qw8, lq, w_read, win = dev_args
     if collect:
         t0 = sync(alen, "h2d", t0)
     cov = None
     ovf = jnp.zeros(plan.n_win, dtype=bool)
     for r in range(rounds):
-        bb, bbw, alen, begin, end, cov, ovf = device_round(
+        bb, bbw, alen, begin, end, cov, ovf = rnd(
             bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf,
             match=match, mismatch=mismatch, gap=gap, ins_scale=ins_scale,
             Lq=plan.Lq, steps=plan.steps, n_win=plan.n_win,

@@ -41,7 +41,9 @@ def fw_dirs_xla(tbuf: jnp.ndarray, qT: jnp.ndarray, *, match: int,
     jr = jnp.arange(Lt, dtype=jnp.int32)[None, :]
     jg = (jr + 1) * gap
     t32 = tbuf.astype(jnp.int32)
-    P0 = jg + jnp.zeros((B, 1), jnp.int32)            # H[0][j] = j*gap
+    # H[0][j] = j*gap. Derived from t32 (not a fresh constant) so the
+    # scan carry is device-varying under shard_map.
+    P0 = jg + jnp.zeros_like(t32[:, :1])
 
     def step(P, inp):
         i, qrow = inp

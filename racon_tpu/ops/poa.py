@@ -111,8 +111,9 @@ class PoaEngine:
         if backend == "auto":
             backend = "jax" if _accelerator_present() else "native"
         self.backend = backend
-        # Optional jax.sharding.Mesh: alignment batches shard over its
-        # "dp" axis (racon_tpu/parallel/dispatch.py).
+        # Optional jax.sharding.Mesh: the device engine shards every
+        # chunk's job axis over the mesh's "dp" devices
+        # (racon_tpu/ops/device_poa.py::device_round_sharded).
         self.mesh = mesh
         # OS threads for the native host aligner (reference -t).
         self.threads = threads
@@ -137,10 +138,10 @@ class PoaEngine:
                 active.append(w)
         if not active:
             return 0
-        # The device engine does not shard yet; an explicit mesh routes
-        # through the host-orchestrated path whose aligner shards over dp
-        # (racon_tpu/parallel/dispatch.py).
-        if self.backend == "jax" and self.mesh is None:
+        # backend "jax": device-resident engine; with a mesh, chunks shard
+        # their job axis over the mesh's "dp" devices
+        # (device_poa.device_round_sharded — one psum per round).
+        if self.backend == "jax":
             dev, host, lq_max, la_max = self._partition_device(active)
             n = 0
             if dev:
@@ -222,11 +223,14 @@ class PoaEngine:
                 ws.append(active[i])
                 jobs += active[i].n_layers
                 i += 1
-            plan = ChunkPlan(ws, lq_cap=lq_cap, la_cap=la_cap)
+            plan = ChunkPlan(ws, lq_cap=lq_cap, la_cap=la_cap,
+                             n_shards=(self.mesh.shape["dp"]
+                                       if self.mesh is not None else 1))
             codes, covs = run_chunk(
                 plan, match=self.match, mismatch=self.mismatch,
                 gap=self.gap, ins_scale=self.ins_scale,
-                rounds=self.refine_rounds + 1, stats=self.stats)
+                rounds=self.refine_rounds + 1, stats=self.stats,
+                mesh=self.mesh)
             trunc: List[Window] = []
             for w, c, cv in zip(ws, codes, covs):
                 if c is None:
@@ -360,16 +364,10 @@ class PoaEngine:
                 lt[b] = j.t_len
                 q[b, :lq[b]] = j.q
                 t[b, :lt[b]] = j.t
-            if self.mesh is not None:
-                from racon_tpu.parallel.dispatch import nw_align_batch_sharded
-                ops, n = nw_align_batch_sharded(
-                    self.mesh, q, t, lq, lt, match=self.match,
-                    mismatch=self.mismatch, gap=self.gap)
-            else:
-                from racon_tpu.ops.align import nw_align_auto
-                ops, n = nw_align_auto(
-                    q, t, lq, lt, match=self.match,
-                    mismatch=self.mismatch, gap=self.gap)
+            from racon_tpu.ops.align import nw_align_auto
+            ops, n = nw_align_auto(
+                q, t, lq, lt, match=self.match,
+                mismatch=self.mismatch, gap=self.gap)
             ops = np.asarray(ops)
             n = np.asarray(n)
             W = ops.shape[1]

@@ -101,7 +101,7 @@ def nw_align_batch_sharded(mesh: Mesh, q: np.ndarray, t: np.ndarray,
 @functools.partial(jax.jit,
                    static_argnames=("match", "mismatch", "gap", "mesh"))
 def _sp_scores_jit(q, t, lq, lt, *, match, mismatch, gap, mesh):
-    from jax.experimental.shard_map import shard_map
+    shard_map = jax.shard_map  # stable API (jax.experimental is deprecated)
 
     nsp = mesh.shape["sp"]
     Lt = t.shape[1]

@@ -83,6 +83,26 @@ def test_engine_with_mesh_matches_engine_without():
     assert w_single.consensus == w_mesh.consensus
 
 
+def test_sharded_device_engine_noisy_windows():
+    """device_round_sharded on the 8-device mesh must reproduce the
+    single-device engine bit-for-bit on realistic noisy windows (psum'd
+    vote accumulators, jobs of one window spread across shards)."""
+    from bench import build_windows
+    from racon_tpu.ops.poa import PoaEngine
+
+    ws_ref = build_windows(10, 6, 130, seed=11)
+    ws_dp = build_windows(10, 6, 130, seed=11)
+    assert PoaEngine(backend="jax").consensus_windows(ws_ref) == 10
+    mesh = make_mesh(8, axes=("dp",))
+    assert PoaEngine(backend="jax",
+                     mesh=mesh).consensus_windows(ws_dp) == 10
+    # The psum reassociates f32 vote sums vs the unsharded matmul, so a
+    # sub-ulp tie can legitimately flip a near-tied column; require
+    # near-total agreement rather than strict bit equality.
+    same = sum(a.consensus == b.consensus for a, b in zip(ws_ref, ws_dp))
+    assert same >= 9, f"only {same}/10 windows identical"
+
+
 def test_graft_entry_single_chip():
     import __graft_entry__ as graft
     fn, args = graft.entry()
