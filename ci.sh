@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# CI entry point (reference parity: .travis.yml:32-37 runs racon_test on
+# every build). Runs the full CPU suite, the multi-chip dryrun, and the
+# two-shape device-engine smoke — the regression class that shipped in
+# round 3 (two differently-shaped consensus runs in one process crashed
+# with INVALID_ARGUMENT; reproducible on the CPU backend, see
+# scripts/tpu_two_shape_repro.py).
+set -euo pipefail
+cd "$(dirname "$0")"
+
+echo "[ci] pytest (CPU, 8 virtual devices)"
+python -m pytest tests/ -q
+
+echo "[ci] multi-chip dryrun (8 virtual devices)"
+python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+echo "[ci] two-shape device-engine smoke"
+python scripts/two_shape_smoke.py
+
+echo "[ci] OK"

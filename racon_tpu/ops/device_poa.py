@@ -59,6 +59,7 @@ def _bucket_b(n: int) -> int:
 # compute). jax's executable cache keys on the same shapes, so a history
 # hit is a compile-cache hit.
 _CAP_HISTORY: set = set()
+_BAND_HISTORY: set = set()
 
 
 def run_caps(lq: int, la: int) -> Tuple[int, int]:
@@ -172,6 +173,39 @@ class ChunkPlan:
             self.bbw[wi, :L] = anchor_w[wi]
             self.alen[wi] = L
 
+        # Static band width for the banded forward: covers every job's
+        # round-0 |lt - lq| with >=128 slack each side (later rounds can
+        # shift geometry — the in-round escape bound re-certifies every
+        # lane every round). 0 disables banding when a band would not
+        # beat the full-width kernel. Mirrors _round_core's geometry.
+        L = self.alen[self.win]
+        b_c = np.clip(self.begin, 0, L - 1)
+        e_c = np.clip(self.end, b_c, L - 1)
+        offs = L // 100
+        fullspan = (b_c < offs) & (e_c > L - offs)
+        lt0 = np.where(fullspan, L, e_c - b_c + 1)
+        max_delta = int(np.abs(lt0 - self.lq).max()) if self.n_jobs else 0
+        W = _round_up(max_delta + 2 * 128 + 1, 128)
+        if W + 128 > LA:
+            # Band would not beat full width here; don't record W either,
+            # or an unusable entry could shadow smaller fitting widths
+            # for later chunks (same pitfall run_caps guards against).
+            self.band_w = 0
+        else:
+            # Reuse a previously-compiled band width when one covers
+            # this chunk within 2x *and still fits this LA* (band_w is a
+            # static arg; workload noise across runs must not force
+            # fresh multi-second compiles).
+            best = None
+            for c in _BAND_HISTORY:
+                if (W <= c <= 2 * W and c + 128 <= LA and
+                        (best is None or c < best)):
+                    best = c
+            if best is None:
+                _BAND_HISTORY.add(W)
+                best = W
+            self.band_w = best
+
 
 def _use_pallas(B: int, Lq: int, LA: int) -> bool:
     import os
@@ -186,7 +220,7 @@ def _use_pallas(B: int, Lq: int, LA: int) -> bool:
 
 def _round_core(bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf, *,
                 match, mismatch, gap, ins_scale, Lq, steps, n_win,
-                LA, pallas, axis_name=None):
+                LA, pallas, band_w=0, axis_name=None):
     """One alignment + merge round (traced body, single shard's view).
 
     Returns (new_bb, new_bbw, new_alen, new_begin, new_end, cov, ovf).
@@ -218,29 +252,69 @@ def _round_core(bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf, *,
     t_off = jnp.where(full, 0, b_c).astype(jnp.int32)
     lt = jnp.where(full, L, e_c - b_c + 1).astype(jnp.int32)
 
-    # Target buffer in absolute coordinates: tbuf[b, x] = anchor slice.
-    x = jnp.arange(LA, dtype=jnp.int32)[None, :]
-    ok = x < lt[:, None]
     flat = bb.reshape(-1)
-    gidx = (win[:, None] * LA + jnp.clip(t_off[:, None] + x, 0, LA - 1))
-    tbuf = jnp.where(ok, jnp.take(flat, gidx), 7).astype(jnp.uint8)
-
-    if pallas:
-        from racon_tpu.ops.pallas.flat_kernel import fw_dirs_pallas
-        dirs = fw_dirs_pallas(tbuf, q.T,
-                              match=match, mismatch=mismatch, gap=gap)
+    esc_w = None
+    if band_w:
+        # Diagonal band (racon_tpu/ops/pallas/band_kernel.py): per-lane
+        # geometry pre-baked into a shifted target buffer; exactness per
+        # lane is certified by the same escape bound as the native
+        # aligner, and failing lanes route their windows to the host
+        # redo path via the sticky ovf flag.
+        from racon_tpu.ops.pallas.band_kernel import (
+            fw_dirs_band, fw_dirs_band_xla, fw_traceback_band,
+            band_geometry)
+        klo, wl = band_geometry(lq, lt, band_w)
+        y = jnp.arange(band_w + Lq, dtype=jnp.int32)[None, :]
+        rel = klo[:, None] + y                     # slice-relative index
+        okb = (rel >= 0) & (rel < lt[:, None])
+        gidxb = (win[:, None] * LA +
+                 jnp.clip(t_off[:, None] + rel, 0, LA - 1))
+        tband = jnp.where(okb, jnp.take(flat, gidxb), 7).astype(jnp.uint8)
+        fwd = fw_dirs_band if pallas else fw_dirs_band_xla
+        dirs, hlast = fwd(tband, q.T, klo, lq,
+                          match=match, mismatch=mismatch, gap=gap,
+                          W=band_w)
+        rev = fw_traceback_band(dirs, lq, lt, klo, steps,
+                                transposed=pallas)
+        # Escape bound (see nw.cpp): banded score must beat any path
+        # that leaves the band, else the lane's window is re-polished on
+        # the unbounded host path.
+        xend = jnp.clip(lt - lq - klo, 0, band_w - 1)
+        score = jnp.take_along_axis(hlast, xend[:, None], axis=1)[:, 0]
+        bound = (jnp.maximum(match, 0) * jnp.minimum(lq, lt) +
+                 gap * (jnp.abs(lt - lq) + 2 * wl + 2))
+        esc_w = ((score < bound) | (wl < 16)).astype(jnp.float32)
     else:
-        dirs = flatmod.fw_dirs_xla(tbuf, q.T,
-                                   match=match, mismatch=mismatch, gap=gap)
-    rev = flatmod.fw_traceback(dirs, lq, lt, steps)
+        # Full-width absolute coordinates: tbuf[b, x] = anchor slice.
+        x = jnp.arange(LA, dtype=jnp.int32)[None, :]
+        ok = x < lt[:, None]
+        gidx = (win[:, None] * LA + jnp.clip(t_off[:, None] + x, 0, LA - 1))
+        tbuf = jnp.where(ok, jnp.take(flat, gidx), 7).astype(jnp.uint8)
+        if pallas:
+            from racon_tpu.ops.pallas.flat_kernel import fw_dirs_pallas
+            dirs = fw_dirs_pallas(tbuf, q.T,
+                                  match=match, mismatch=mismatch, gap=gap)
+        else:
+            dirs = flatmod.fw_dirs_xla(tbuf, q.T,
+                                       match=match, mismatch=mismatch,
+                                       gap=gap)
+        rev = flatmod.fw_traceback(dirs, lq, lt, steps)
     ops = jnp.flip(rev, axis=1)
 
     qw = jnp.maximum(qw8.astype(jnp.float32) - 1.0, 0.0)
     votes = dm.extract_votes(ops, q, qw, w_read, lt, t_off, LA,
                              pallas=pallas)
     acc = dm.aggregate_votes(votes, win, n_win + 1)
+    if esc_w is not None:
+        # Per-window band-escape sum joins the accumulator dict so it
+        # rides the same single psum as the votes.
+        Mw = (jnp.arange(n_win + 1, dtype=jnp.int32)[:, None] ==
+              win[None, :]).astype(jnp.float32)
+        acc["_esc"] = jnp.matmul(Mw, esc_w[:, None],
+                                 precision=jax.lax.Precision.HIGHEST)[:, 0]
     if axis_name is not None:
         acc = {k: jax.lax.psum(v, axis_name) for k, v in acc.items()}
+    wesc = acc.pop("_esc", None)
     acc = {k: v[:-1] for k, v in acc.items()}       # drop padded-lane row
     acc = dm.add_backbone(acc, bb[:-1], bbw[:-1], alen[:-1])
     asm = dm.assemble(acc, alen[:-1], ins_scale)
@@ -263,22 +337,24 @@ def _round_core(bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf, *,
                    jnp.take(me_flat, winc * LA + jnp.clip(end, 0, LA - 1)),
                    tot_j - 1).astype(jnp.int32)
     ovf = ovf | (total > LA)
+    if wesc is not None:
+        ovf = ovf | (wesc[:-1] > 0)
     return new_bb, new_bbw, new_alen, nb, ne, cov, ovf
 
 
 device_round = functools.partial(
     __import__("jax").jit,
     static_argnames=("match", "mismatch", "gap", "ins_scale", "Lq", "steps",
-                     "n_win", "LA", "pallas"))(_round_core)
+                     "n_win", "LA", "pallas", "band_w"))(_round_core)
 
 
 @functools.partial(
     __import__("jax").jit,
     static_argnames=("match", "mismatch", "gap", "ins_scale", "Lq", "steps",
-                     "n_win", "LA", "pallas", "mesh"))
+                     "n_win", "LA", "pallas", "band_w", "mesh"))
 def device_round_sharded(bb, bbw, alen, begin, end, q, qw8, lq, w_read,
                          win, ovf, *, match, mismatch, gap, ins_scale, Lq,
-                         steps, n_win, LA, pallas, mesh):
+                         steps, n_win, LA, pallas, band_w, mesh):
     """device_round with the job axis sharded over the mesh's "dp" axis.
 
     Window arrays (anchors, lengths, ovf) stay replicated; each chip
@@ -292,7 +368,7 @@ def device_round_sharded(bb, bbw, alen, begin, end, q, qw8, lq, w_read,
     core = functools.partial(
         _round_core, match=match, mismatch=mismatch, gap=gap,
         ins_scale=ins_scale, Lq=Lq, steps=steps, n_win=n_win, LA=LA,
-        pallas=pallas, axis_name="dp")
+        pallas=pallas, band_w=band_w, axis_name="dp")
     rep = P()
     job = P("dp")
     # check_vma=False: the Pallas kernels' out_shapes carry no varying-
@@ -363,6 +439,8 @@ def run_chunk(plan: ChunkPlan, *, match: int, mismatch: int, gap: int,
 
     ndp = mesh.shape["dp"] if mesh is not None else 1
     pallas = _use_pallas(plan.B // ndp, plan.Lq, plan.LA)
+    band_w = (0 if os.environ.get("RACON_TPU_NO_BAND", "")
+              not in ("", "0", "false") else plan.band_w)
     t0 = time.perf_counter()
     host_args = (plan.bb, plan.bbw, plan.alen, plan.begin, plan.end,
                  plan.q, plan.qw8, plan.lq, plan.w_read, plan.win)
@@ -387,7 +465,7 @@ def run_chunk(plan: ChunkPlan, *, match: int, mismatch: int, gap: int,
             bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf,
             match=match, mismatch=mismatch, gap=gap, ins_scale=ins_scale,
             Lq=plan.Lq, steps=plan.steps, n_win=plan.n_win,
-            LA=plan.LA, pallas=pallas)
+            LA=plan.LA, pallas=pallas, band_w=band_w)
         if verbose:
             t0 = sync(cov, f"compute/round{r}", t0)
     if collect and not verbose:

@@ -1,0 +1,258 @@
+"""Pallas TPU kernel: banded NW forward in per-lane diagonal coordinates.
+
+The full-width kernel (flat_kernel.py) computes H over all Lt target
+columns; at bench shapes its dirs tensor is the HBM ceiling (~1.5 GB per
+refinement round, PROFILE.md #3). This kernel restricts each job to a
+static-width diagonal band of W slots centered on its own length
+difference — band column x of row i is target column
+
+    j = i + klo_b + x,      klo_b = min(0, lt_b - lq_b) - wl_b,
+    wl_b = (W - 1 - |lt_b - lq_b|) // 2
+
+so the diag neighbour of (i, x) is (i-1, x) (same lane), the up
+neighbour is (i-1, x+1) (static shift by one), and the left-gap chain
+stays a lane-local cummax — no dynamic roll anywhere (pltpu.roll with a
+dynamic shift corrupts >512-lane rows on this stack, PROFILE.md #6).
+The per-lane geometry lives entirely in a pre-shifted target buffer
+built by the caller:
+
+    tband[b, y] = anchor_b[klo_b + y]   for y in [0, W + Lq)
+
+(row i's window is tband[:, i-1 : i-1+W] — a row-uniform dynamic lane
+slice). Out-of-matrix cells carry -inf-like scores so no in-band path
+crosses them; cells right of each job's lt hold garbage the traceback
+never visits (it starts at (lq, lt) and moves down-left), exactly like
+the full-width kernel's padding story.
+
+Exactness: the kernel also emits each lane's final row H[lq_b] (captured
+when the row counter passes lq_b), from which the caller reads the
+terminal score and applies the same provable escape bound as the native
+aligner (racon_tpu/native/nw.cpp): any path leaving half-width w needs
+more than |lt-lq| + 2(w+1) gap ops, so
+
+    score >= max(m,0)*min(lq,lt) + g*(|lt-lq| + 2*wl + 2)
+
+proves the banded optimum is the global optimum. Lanes that fail the
+bound are flagged and their windows re-polished on the unbounded host
+path (the ovf redo route in PoaEngine) — with w >= 128 and 500-base
+windows this is a theoretical safety valve, not a hot path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from racon_tpu.ops.cigar import DIAG, UP, LEFT
+
+_NEG = -(2 ** 30)
+TB = 128   # jobs per grid program (sublanes)
+CH = 32    # query rows per grid step
+
+
+def _kernel(tbandT_ref, qT_ref, klo_ref, lq_ref, dirs_ref, hlast_ref,
+            prev_ref, *, match, mismatch, gap, W):
+    # Transposed layout: band slots x on SUBLANES, jobs on LANES. The
+    # per-row moving target window is then a dynamic *sublane* slice
+    # (supported by Mosaic at any offset), where the lane-major variant
+    # would need a 128-aligned dynamic lane slice (rejected).
+    c = pl.program_id(1)
+    xr = jax.lax.broadcasted_iota(jnp.int32, (W, TB), 0)
+    klo = klo_ref[0]                       # [TB] int32
+    lqv = lq_ref[0]                        # [TB] int32
+
+    @pl.when(c == 0)
+    def _():
+        # prev[x] = H[0][klo + x] = (klo+x)*gap where klo+x >= 0 (the
+        # j = 0 column holds 0 = H[0][0]); cells left of j=0 are -inf.
+        j0 = klo[None, :] + xr
+        prev_ref[:] = jnp.where(j0 >= 0, j0 * gap, _NEG)
+        hlast_ref[:] = jnp.where(j0 >= 0, j0 * gap, _NEG)
+
+    def row(r, _):
+        i = c * CH + r + 1                 # 1-based global row
+        qrow = qT_ref[r]                   # [TB] int32
+        tw = tbandT_ref[pl.dslice(i - 1, W), :]           # [W, TB] int32
+        jcol = i + klo[None, :] + xr       # absolute target column j
+        sub = jnp.where(tw == qrow[None, :], match, mismatch)
+        sub = jnp.where(jcol >= 1, sub, _NEG)  # no diag into j < 1
+        P = prev_ref[:]
+        diag = P + sub
+        up = jnp.concatenate(
+            [P[1:, :], jnp.full((1, TB), _NEG, jnp.int32)], axis=0) + gap
+        tmp = jnp.maximum(diag, up)
+        # j == 0 boundary column: H[i][0] = i*gap, entering at x0 = -i-klo.
+        tmp = jnp.where(jcol == 0, i * gap, tmp)
+        # Left-gap chain: shift-max ladder along sublanes (j grows with x).
+        jg = jcol * gap
+        f = tmp - jg
+        s = 1
+        while s < W:
+            f = jnp.maximum(
+                f, jnp.concatenate(
+                    [jnp.full((s, TB), _NEG // 2, jnp.int32), f[:-s, :]],
+                    axis=0))
+            s *= 2
+        h = f + jg
+        h = jnp.where(jcol >= 0, h, _NEG)
+        d = jnp.where(h == diag, DIAG,
+                      jnp.where(h == up, UP, LEFT)).astype(jnp.uint8)
+        dirs_ref[r] = d
+        prev_ref[:] = h
+        # Capture each lane's true final row as the row counter passes it.
+        hlast_ref[:] = jnp.where((lqv == i)[None, :], h, hlast_ref[:])
+        return 0
+
+    jax.lax.fori_loop(0, CH, row, 0)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("match", "mismatch", "gap", "W"))
+def fw_dirs_band(tband: jnp.ndarray, qT: jnp.ndarray, klo: jnp.ndarray,
+                 lq: jnp.ndarray, *, match: int, mismatch: int, gap: int,
+                 W: int):
+    """Banded direction tensor + final-row scores (Pallas, transposed).
+
+    Args:
+      tband: int32[B, W + Lq] pre-shifted targets (see module docstring).
+      qT:    uint8/int32[Lq, B] queries, transposed.
+      klo:   int32[B] per-lane band origin.
+      lq:    int32[B] per-lane query lengths (for final-row capture).
+
+    Returns (dirs uint8[Lq, W, B], hlast int32[B, W]) — note dirs has
+    band slots *before* jobs (kernel layout); fw_traceback_band takes
+    ``transposed=True`` for it. hlast[b, x] = H[lq_b][lq_b + klo_b + x].
+    B % 128 == 0, Lq % 32 == 0, W % 128 == 0 required.
+    """
+    B = tband.shape[0]
+    Lq = qT.shape[0]
+    kernel = functools.partial(_kernel, match=match, mismatch=mismatch,
+                               gap=gap, W=W)
+    dirs, hlast = pl.pallas_call(
+        kernel,
+        grid=(B // TB, Lq // CH),
+        in_specs=[
+            pl.BlockSpec((W + Lq, TB), lambda b, c: (0, b),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((CH, TB), lambda b, c: (c, b),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, TB), lambda b, c: (0, b),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, TB), lambda b, c: (0, b),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((CH, W, TB), lambda b, c: (c, 0, b),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((W, TB), lambda b, c: (0, b),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((Lq, W, B), jnp.uint8),
+            jax.ShapeDtypeStruct((W, B), jnp.int32),
+        ],
+        scratch_shapes=[pltpu.VMEM((W, TB), jnp.int32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")),
+    )(tband.astype(jnp.int32).T, qT.astype(jnp.int32),
+      klo[None, :], lq[None, :])
+    return dirs, hlast.T
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("match", "mismatch", "gap", "W"))
+def fw_dirs_band_xla(tband: jnp.ndarray, qT: jnp.ndarray, klo: jnp.ndarray,
+                     lq: jnp.ndarray, *, match: int, mismatch: int,
+                     gap: int, W: int):
+    """Row-scan twin of fw_dirs_band (CPU tests / non-TPU fallback);
+    bit-identical outputs by construction."""
+    B = tband.shape[0]
+    Lq = qT.shape[0]
+    xr = jnp.arange(W, dtype=jnp.int32)[None, :]
+    t32 = tband.astype(jnp.int32)
+    j0 = klo[:, None] + xr
+    P0 = jnp.where(j0 >= 0, j0 * gap, _NEG) + jnp.zeros_like(t32[:, :1])
+    hl0 = P0
+
+    def step(carry, inp):
+        P, hl = carry
+        i, qrow = inp
+        tw = jax.lax.dynamic_slice_in_dim(t32, i - 1, W, axis=1)
+        jcol = i + klo[:, None] + xr
+        sub = jnp.where(tw == qrow[:, None], match, mismatch)
+        sub = jnp.where(jcol >= 1, sub, _NEG)
+        diag = P + sub
+        up = jnp.concatenate(
+            [P[:, 1:], jnp.full((B, 1), _NEG, jnp.int32)], axis=1) + gap
+        tmp = jnp.maximum(diag, up)
+        tmp = jnp.where(jcol == 0, i * gap, tmp)
+        jg = jcol * gap
+        f = tmp - jg
+        s = 1
+        while s < W:
+            f = jnp.maximum(
+                f, jnp.concatenate(
+                    [jnp.full((B, s), _NEG // 2, jnp.int32), f[:, :-s]],
+                    axis=1))
+            s *= 2
+        h = f + jg
+        h = jnp.where(jcol >= 0, h, _NEG)
+        d = jnp.where(h == diag, DIAG,
+                      jnp.where(h == up, UP, LEFT)).astype(jnp.uint8)
+        hl = jnp.where((lq == i)[:, None], h, hl)
+        return (h, hl), d
+
+    ii = jnp.arange(1, Lq + 1, dtype=jnp.int32)
+    (_, hlast), dirs = jax.lax.scan(step, (P0, hl0),
+                                    (ii, qT.astype(jnp.int32)))
+    return dirs, hlast
+
+
+def band_geometry(lq, lt, W: int):
+    """Per-lane (klo, wl) for a W-slot band (all int32 vectors)."""
+    delta = lt - lq
+    wl = (W - 1 - jnp.abs(delta)) // 2
+    klo = jnp.minimum(0, delta) - wl
+    return klo, wl
+
+
+def fw_traceback_band(dirs: jnp.ndarray, lq: jnp.ndarray, lt: jnp.ndarray,
+                      klo: jnp.ndarray, steps: int,
+                      transposed: bool = False):
+    """Traceback over banded dirs: rev ops uint8[B, steps].
+
+    Identical walk to flat.fw_traceback with the column index mapped to
+    band coordinates x = j - i - klo per lane. ``transposed`` selects
+    the Pallas kernel's [Lq, W, B] dirs layout (vs [Lq, B, W]).
+    """
+    if transposed:
+        Lq, W, B = dirs.shape
+    else:
+        Lq, B, W = dirs.shape
+    d1 = dirs.reshape(-1)
+    lane = jnp.arange(B, dtype=jnp.int32)
+
+    def step(state, _):
+        i, j = state
+        done = (i == 0) & (j == 0)
+        x = jnp.clip(j - i - klo, 0, W - 1)
+        if transposed:
+            idx = (jnp.maximum(i - 1, 0) * (B * W) + x * B + lane)
+        else:
+            idx = (jnp.maximum(i - 1, 0) * (B * W) + lane * W + x)
+        dv = jnp.take(d1, idx)
+        d = jnp.where(done, 3,
+                      jnp.where(i == 0, LEFT,
+                                jnp.where(j == 0, UP, dv))).astype(jnp.uint8)
+        i = i - jnp.where((d == DIAG) | (d == UP), 1, 0).astype(i.dtype)
+        j = j - jnp.where((d == DIAG) | (d == LEFT), 1, 0).astype(j.dtype)
+        return (i, j), d
+
+    (_, _), rev_ops = jax.lax.scan(
+        step, (lq.astype(jnp.int32), lt.astype(jnp.int32)), None,
+        length=steps)
+    return rev_ops.T

@@ -1,0 +1,34 @@
+"""CI smoke: two differently-shaped device-engine runs in one process.
+
+Round 3 shipped a crash in exactly this pattern (a module-level
+jax.Array constant lowered as a hoisted executable parameter that the
+execution path then under-supplied — INVALID_ARGUMENT / "Execution
+supplied N buffers but compiled program expected N+1"). Runs on
+whatever backend is available: the failure reproduced on the CPU
+backend too, so CI without a TPU still guards it.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import build_windows                      # noqa: E402
+from racon_tpu.ops.poa import PoaEngine              # noqa: E402
+
+
+def main():
+    # Geometry chosen so run-level padding caps differ between the runs
+    # (different Lq/LA buckets -> genuinely distinct executables).
+    for n, cov, wlen, seed in ((6, 6, 120, 3), (5, 8, 150, 7),
+                               (4, 10, 260, 11)):
+        ws = build_windows(n, cov, wlen, seed=seed)
+        eng = PoaEngine(backend="jax")
+        assert eng.consensus_windows(ws) == n
+        assert all(w.consensus for w in ws)
+        print(f"[smoke] ok: {n} windows, wlen={wlen}", flush=True)
+    print("[smoke] PASS", flush=True)
+
+
+if __name__ == "__main__":
+    main()
