@@ -227,8 +227,10 @@ def extract_votes(ops, q, qw, w_read, lt, t_off, LA: int,
     }
 
 
-def aggregate_votes(votes, win, n_win: int):
-    """Sum per-job channels into per-window accumulators via one-hot matmul."""
+def aggregate_votes(votes, win, n_win: int, extras=None):
+    """Sum per-job channels into per-window accumulators via one-hot
+    matmul. ``extras``: optional dict of per-job [B] scalars summed per
+    window with the same membership matrix (returned under their keys)."""
     B = win.shape[0]
     M = (jnp.arange(n_win, dtype=jnp.int32)[:, None] ==
          win[None, :]).astype(jnp.float32)            # [Nw, B]
@@ -244,6 +246,9 @@ def aggregate_votes(votes, win, n_win: int):
          votes["ins1_stop"], votes["pile_w"], votes["pile_c"],
          votes["lenw"]], axis=-1))
     out = {}
+    if extras:
+        for k, v in extras.items():
+            out[k] = jnp.matmul(M, v[:, None], precision=_PREC)[:, 0]
     out["base_w"] = col[..., :NBASE + 1]              # [Nw, LA, 6] (5=del)
     out["base_c"] = col[..., NBASE + 1:]              # [Nw, LA, 5]
     i = 0

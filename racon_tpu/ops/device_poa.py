@@ -304,14 +304,11 @@ def _round_core(bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf, *,
     qw = jnp.maximum(qw8.astype(jnp.float32) - 1.0, 0.0)
     votes = dm.extract_votes(ops, q, qw, w_read, lt, t_off, LA,
                              pallas=pallas)
-    acc = dm.aggregate_votes(votes, win, n_win + 1)
-    if esc_w is not None:
-        # Per-window band-escape sum joins the accumulator dict so it
-        # rides the same single psum as the votes.
-        Mw = (jnp.arange(n_win + 1, dtype=jnp.int32)[:, None] ==
-              win[None, :]).astype(jnp.float32)
-        acc["_esc"] = jnp.matmul(Mw, esc_w[:, None],
-                                 precision=jax.lax.Precision.HIGHEST)[:, 0]
+    # The band-escape per-window sum rides aggregate_votes' membership
+    # matrix and the same single psum as the votes.
+    acc = dm.aggregate_votes(
+        votes, win, n_win + 1,
+        extras={"_esc": esc_w} if esc_w is not None else None)
     if axis_name is not None:
         acc = {k: jax.lax.psum(v, axis_name) for k, v in acc.items()}
     wesc = acc.pop("_esc", None)

@@ -93,7 +93,8 @@ class PoaEngine:
     def __init__(self, match: int = 5, mismatch: int = -4, gap: int = -8,
                  backend: str = "auto", device_batch: int = 4096,
                  refine_rounds: int = 3, ins_scale: float = 0.3,
-                 mesh=None, log=sys.stderr, threads: int = 1):
+                 ins_scale_unit: float = 0.25, mesh=None, log=sys.stderr,
+                 threads: int = 1):
         if gap >= 0:
             raise ValueError(
                 "[racon_tpu::PoaEngine] error: gap penalty must be negative!")
@@ -105,8 +106,17 @@ class PoaEngine:
         # backbone errors consolidate onto real columns.
         self.refine_rounds = refine_rounds
         # Insertion-vs-crossing vote scale (<1 counters the systematic
-        # deficit insertion columns suffer from alignment scatter).
+        # deficit insertion columns suffer from alignment scatter). The
+        # scatter statistics differ between Phred-weighted and unit
+        # weights (quality-less FASTA input, reference src/window.cpp:69
+        # adds such layers weightless), so each regime carries its own
+        # calibration; consensus_windows picks per run by majority.
+        # Measured on the lambda goldens: quality configs optimal near
+        # 0.3 (EDs 1288/1305/1275 vs goldens 1312/1317/1289), unit
+        # configs near 0.25 (FASTA ED 1687 -> 1626 vs golden 1566).
         self.ins_scale = ins_scale
+        self.ins_scale_unit = ins_scale_unit
+        self._eff_ins_scale = ins_scale
         self.log = log
         if backend == "auto":
             backend = "jax" if _accelerator_present() else "native"
@@ -138,6 +148,13 @@ class PoaEngine:
                 active.append(w)
         if not active:
             return 0
+        # Pick the insertion-scale calibration for this run's weight
+        # regime (majority of layers Phred-weighted vs unit-weight).
+        n_q = sum(1 for w in active for q in w.layer_quality
+                  if q is not None)
+        n_l = sum(w.n_layers for w in active)
+        self._eff_ins_scale = (self.ins_scale if 2 * n_q >= n_l
+                               else self.ins_scale_unit)
         # backend "jax": device-resident engine; with a mesh, chunks shard
         # their job axis over the mesh's "dp" devices
         # (device_poa.device_round_sharded — one psum per round).
@@ -199,6 +216,10 @@ class PoaEngine:
         if _bucket_b(jobs_cap) * lq_cap * la_cap > MAX_DIR_ELEMS:
             # Even a minimum-bucket chunk overflows the int32 flat-index
             # range at these caps (pathological mixed geometry): host path.
+            print(f"[racon_tpu::PoaEngine] run geometry (Lq={lq_cap}, "
+                  f"LA={la_cap}) overflows the device index budget even "
+                  f"at the minimum chunk size; polishing {len(active)} "
+                  "window(s) on the host path", file=self.log)
             return self._consensus_host(active, force_native=True)
         # Windows too wide for any chunk at these caps take the host path
         # ("not ws" below would otherwise admit them into an over-cap
@@ -228,7 +249,7 @@ class PoaEngine:
                                        if self.mesh is not None else 1))
             codes, covs = run_chunk(
                 plan, match=self.match, mismatch=self.mismatch,
-                gap=self.gap, ins_scale=self.ins_scale,
+                gap=self.gap, ins_scale=self._eff_ins_scale,
                 rounds=self.refine_rounds + 1, stats=self.stats,
                 mesh=self.mesh)
             trunc: List[Window] = []
@@ -441,7 +462,7 @@ class PoaEngine:
         ins1_w2 = ins1_w.reshape(total_g, ALPHABET)
         g_tot = ins1_w2.sum(axis=1)
         g_arg = np.argmax(ins1_w2, axis=1)
-        emit1 = g_tot > direct_w * self.ins_scale
+        emit1 = g_tot > direct_w * self._eff_ins_scale
 
         # Hand each window only its own piles (sorted keys + searchsorted,
         # instead of scanning the round-global dict per window).
@@ -471,7 +492,7 @@ class PoaEngine:
                 gg = int(gg)
                 pile = piles[gg]
                 seq, cnt = pile.consensus(
-                    float(direct_w[gg]) * self.ins_scale,
+                    float(direct_w[gg]) * self._eff_ins_scale,
                     ins1_w2[gg], ins1_c.reshape(total_g, ALPHABET)[gg],
                     float(ins1_stop[gg]))
                 if len(seq):

@@ -127,18 +127,19 @@ def test_consensus_paf_with_qualities(ref_data_module, reference_genome):
 
 @pytest.mark.slow
 def test_consensus_paf_without_qualities(ref_data_module, reference_genome):
-    """Reference golden 1566 (racon_test.cpp:109-129); ours ~1693."""
+    """Reference golden 1566 (racon_test.cpp:109-129); ours ~1626
+    (unit-weight ins_scale calibration, measured on TPU 2026-07-30)."""
     out = _polish(ref_data_module, "sample_reads.fasta.gz",
                   "sample_overlaps.paf.gz")
-    _check(out, reference_genome, 1566, 1800)
+    _check(out, reference_genome, 1566, 1700)
 
 
 @pytest.mark.slow
 def test_consensus_sam_without_qualities(ref_data_module, reference_genome):
-    """Reference golden 1770 (racon_test.cpp:153-173); ours ~1981."""
+    """Reference golden 1770 (racon_test.cpp:153-173); ours ~1973."""
     out = _polish(ref_data_module, "sample_reads.fasta.gz",
                   "sample_overlaps.sam.gz")
-    _check(out, reference_genome, 1770, 2100)
+    _check(out, reference_genome, 1770, 2050)
 
 
 @pytest.mark.slow
