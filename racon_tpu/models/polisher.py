@@ -302,6 +302,12 @@ class Polisher:
         log.begin()
 
         n_windows = len(self.windows)
+        # Fix the weight-regime calibration from the run-global layer
+        # counts so window chunking cannot flip it mid-run.
+        self.engine.set_weight_regime(
+            sum(1 for w in self.windows for q in w.layer_quality
+                if q is not None),
+            sum(w.n_layers for w in self.windows))
         for s in range(0, n_windows, self.window_chunk):
             self.engine.consensus_windows(self.windows[s:s + self.window_chunk])
             log.tick("[racon_tpu::Polisher::polish] generating consensus")

@@ -117,6 +117,7 @@ class PoaEngine:
         self.ins_scale = ins_scale
         self.ins_scale_unit = ins_scale_unit
         self._eff_ins_scale = ins_scale
+        self._regime_fixed = False
         self.log = log
         if backend == "auto":
             backend = "jax" if _accelerator_present() else "native"
@@ -134,6 +135,16 @@ class PoaEngine:
 
     # ------------------------------------------------------------ public API
 
+    def set_weight_regime(self, n_quality_layers: int,
+                          n_layers: int) -> None:
+        """Fix the insertion-scale calibration for a whole run from the
+        global layer counts (call before the first consensus_windows so
+        window chunking cannot flip the regime mid-run)."""
+        self._eff_ins_scale = (
+            self.ins_scale if 2 * n_quality_layers >= n_layers
+            else self.ins_scale_unit)
+        self._regime_fixed = True
+
     def consensus_windows(self, windows: List[Window]) -> int:
         """Fill ``consensus`` for every window; returns #polished.
 
@@ -148,13 +159,16 @@ class PoaEngine:
                 active.append(w)
         if not active:
             return 0
-        # Pick the insertion-scale calibration for this run's weight
-        # regime (majority of layers Phred-weighted vs unit-weight).
-        n_q = sum(1 for w in active for q in w.layer_quality
-                  if q is not None)
-        n_l = sum(w.n_layers for w in active)
-        self._eff_ins_scale = (self.ins_scale if 2 * n_q >= n_l
-                               else self.ins_scale_unit)
+        # Pick the insertion-scale calibration for the weight regime.
+        # Polisher fixes it once for the whole run via set_weight_regime
+        # (so chunking cannot flip it mid-run on mixed input); direct
+        # engine users fall back to a per-call majority.
+        if not self._regime_fixed:
+            n_q = sum(1 for w in active for q in w.layer_quality
+                      if q is not None)
+            n_l = sum(w.n_layers for w in active)
+            self._eff_ins_scale = (self.ins_scale if 2 * n_q >= n_l
+                                   else self.ins_scale_unit)
         # backend "jax": device-resident engine; with a mesh, chunks shard
         # their job axis over the mesh's "dp" devices
         # (device_poa.device_round_sharded — one psum per round).

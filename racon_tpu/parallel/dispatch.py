@@ -98,11 +98,69 @@ def nw_align_batch_sharded(mesh: Mesh, q: np.ndarray, t: np.ndarray,
     return np.asarray(ops)[:B], np.asarray(n)[:B]
 
 
+def _sp_forward(sp, nsp, jglob, qv, tv, a, *, match, mismatch, gap,
+                emit_dirs):
+    """Shared sequence-parallel NW forward scan over query rows.
+
+    One target shard's view: local cummax + a cross-chip prefix of block
+    maxima close the global gap chain, a one-column ppermute halo feeds
+    the next row's diagonal, and rows freeze past the true query length
+    so the final carry holds row lq. With ``emit_dirs`` the scan also
+    yields per-row direction labels (DIAG > UP > LEFT, the rule every
+    other kernel uses); _sp_scores_jit and _sp_align_jit both ride this
+    single implementation so scores and tracebacks cannot desynchronize.
+
+    Returns (final_row, dirs-or-None).
+    """
+    from racon_tpu.ops.cigar import DIAG, UP, LEFT
+
+    row0 = jglob * gap
+    halo0 = (sp * jglob.shape[0]) * gap   # H[0, first_j - 1]
+
+    def step(carry, inp):
+        prev, halo = carry
+        i, qi = inp
+        sub = jnp.where(tv == qi, match, mismatch).astype(jnp.int32)
+        prev_shift = jnp.concatenate([halo[None], prev[:-1]])
+        diag = prev_shift + sub
+        up = prev + gap
+        tmp = jnp.maximum(diag, up)
+        # Global gap-chain closure: local cummax + cross-chip prefix of
+        # block maxima + the j=0 boundary (i*gap).
+        f = tmp - jglob * gap
+        lmax = jax.lax.cummax(f)
+        blockmax = jax.lax.all_gather(lmax[-1], "sp")
+        idx = jnp.arange(nsp)
+        before = jnp.where(idx < sp, blockmax,
+                           jnp.iinfo(jnp.int32).min // 2)
+        prefix = jnp.maximum(jnp.max(before), i * gap)
+        h = jnp.maximum(lmax, prefix) + jglob * gap
+        d = (jnp.where(h == diag, DIAG,
+                       jnp.where(h == up, UP, LEFT)).astype(jnp.uint8)
+             if emit_dirs else None)
+        # Row frozen past the true query length so the final carry
+        # holds row lq.
+        h = jnp.where(i <= a, h, prev)
+        # Halo for the next row: my last column -> right neighbour.
+        nh = jax.lax.ppermute(
+            h[-1], "sp", [(k, k + 1) for k in range(nsp - 1)])
+        nh = jnp.where(sp == 0, i * gap, nh)
+        nh = jnp.where(i <= a, nh, halo)
+        return (h, nh), d
+
+    ii = jnp.arange(1, qv.shape[0] + 1, dtype=jnp.int32)
+    # The scan body outputs are dp-varying (they read qv/tv), so the
+    # initial carry must carry the same varying-axes type.
+    carry0 = (jax.lax.pvary(row0, ("dp",)),
+              jax.lax.pvary(jnp.int32(halo0), ("dp",)))
+    (final, _), dirs = jax.lax.scan(step, carry0,
+                                    (ii, qv.astype(jnp.int32)))
+    return final, dirs
+
+
 @functools.partial(jax.jit,
                    static_argnames=("match", "mismatch", "gap", "mesh"))
 def _sp_scores_jit(q, t, lq, lt, *, match, mismatch, gap, mesh):
-    shard_map = jax.shard_map  # stable API (jax.experimental is deprecated)
-
     nsp = mesh.shape["sp"]
     Lt = t.shape[1]
     assert Lt % nsp == 0
@@ -114,51 +172,19 @@ def _sp_scores_jit(q, t, lq, lt, *, match, mismatch, gap, mesh):
         jglob = sp * Ltl + jnp.arange(1, Ltl + 1, dtype=jnp.int32)
 
         def one(qv, tv, a, bcol):
-            row0 = jglob * gap
-            halo0 = (sp * Ltl) * gap  # H[0, first_j - 1]
-
-            def step(carry, inp):
-                prev, halo = carry
-                i, qi = inp
-                sub = jnp.where(tv == qi, match, mismatch).astype(jnp.int32)
-                prev_shift = jnp.concatenate([halo[None], prev[:-1]])
-                tmp = jnp.maximum(prev_shift + sub, prev + gap)
-                # Global gap-chain closure: local cummax + cross-chip
-                # prefix of block maxima + the j=0 boundary (i*gap).
-                f = tmp - jglob * gap
-                lmax = jax.lax.cummax(f)
-                blockmax = jax.lax.all_gather(lmax[-1], "sp")
-                idx = jnp.arange(nsp)
-                before = jnp.where(idx < sp, blockmax,
-                                   jnp.iinfo(jnp.int32).min // 2)
-                prefix = jnp.maximum(jnp.max(before), i * gap)
-                h = jnp.maximum(lmax, prefix) + jglob * gap
-                # Row frozen past the true query length so the final carry
-                # holds row lq.
-                h = jnp.where(i <= a, h, prev)
-                # Halo for the next row: my last column -> right neighbour.
-                nh = jax.lax.ppermute(
-                    h[-1], "sp", [(k, k + 1) for k in range(nsp - 1)])
-                nh = jnp.where(sp == 0, i * gap, nh)
-                nh = jnp.where(i <= a, nh, halo)
-                return (h, nh), None
-
-            ii = jnp.arange(1, qv.shape[0] + 1, dtype=jnp.int32)
-            # The scan body outputs are dp-varying (they read qv/tv), so
-            # the initial carry must carry the same varying-axes type.
-            carry0 = (jax.lax.pvary(row0, ("dp",)),
-                      jax.lax.pvary(jnp.int32(halo0), ("dp",)))
-            (final, _), _ = jax.lax.scan(
-                step, carry0, (ii, qv.astype(jnp.int32)))
+            final, _ = _sp_forward(sp, nsp, jglob, qv, tv, a, match=match,
+                                   mismatch=mismatch, gap=gap,
+                                   emit_dirs=False)
             # Score H[lq, lt] lives on the chip owning global column lt.
             mine = jnp.sum(jnp.where(jglob == bcol, final, 0))
             return jax.lax.psum(mine, "sp")
 
         return jax.vmap(one)(qb, tb, lqb, ltb)
 
-    fn = shard_map(block, mesh=mesh,
-                   in_specs=(P("dp", None), P("dp", "sp"), P("dp"), P("dp")),
-                   out_specs=P("dp"))
+    fn = jax.shard_map(
+        block, mesh=mesh,
+        in_specs=(P("dp", None), P("dp", "sp"), P("dp"), P("dp")),
+        out_specs=P("dp"))
     return fn(q, t, lq, lt)
 
 
@@ -171,3 +197,115 @@ def sp_nw_scores(mesh: Mesh, q: np.ndarray, t: np.ndarray, lq: np.ndarray,
     out = _sp_scores_jit(qd, td, lqd, ltd, match=match, mismatch=mismatch,
                          gap=gap, mesh=mesh)
     return np.asarray(out)[:B]
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("match", "mismatch", "gap", "mesh"))
+def _sp_align_jit(q, t, lq, lt, *, match, mismatch, gap, mesh):
+    """Sequence-parallel NW *with traceback*: target axis sharded over
+    "sp", batch over "dp".
+
+    The forward pass is the sp scan of _sp_scores_jit, additionally
+    emitting per-row direction labels into each shard's local dirs
+    [Lq, Lt/nsp] (diag/up come from local state, LEFT covers the
+    prefix-max gap chain regardless of which shard supplied it). The
+    traceback is a *replicated* walk over all sp shards: every step the
+    owning shard gathers its direction bit and one psum broadcasts it
+    (tiny — one int per job per step over ICI), so the path crosses
+    shard boundaries with no host round-trips and no dirs gather.
+    """
+    from racon_tpu.ops.align import PAD_OP
+    from racon_tpu.ops.cigar import DIAG, UP, LEFT
+
+    nsp = mesh.shape["sp"]
+    Lq = q.shape[1]
+    Lt = t.shape[1]
+    assert Lt % nsp == 0
+    steps = Lq + Lt
+
+    def block(qb, tb, lqb, ltb):
+        sp = jax.lax.axis_index("sp")
+        Ltl = tb.shape[1]
+        jglob = sp * Ltl + jnp.arange(1, Ltl + 1, dtype=jnp.int32)
+
+        def one(qv, tv, a, bcol):
+            _, dirs = _sp_forward(sp, nsp, jglob, qv, tv, a, match=match,
+                                  mismatch=mismatch, gap=gap,
+                                  emit_dirs=True)               # [Lq, Ltl]
+
+            # Replicated cross-shard walk from (lq, lt) to (0, 0).
+            d1 = dirs.reshape(-1)
+            base = sp * Ltl
+
+            def tstep(state, _):
+                i, j = state
+                done = (i == 0) & (j == 0)
+                loc = j - 1 - base
+                own = (i >= 1) & (j >= 1) & (loc >= 0) & (loc < Ltl)
+                idx = jnp.clip((i - 1) * Ltl + loc, 0, Lq * Ltl - 1)
+                dv = jnp.where(own, jnp.take(d1, idx).astype(jnp.int32), 0)
+                dv = jax.lax.psum(dv, "sp")
+                d = jnp.where(done, PAD_OP,
+                              jnp.where(i == 0, LEFT,
+                                        jnp.where(j == 0, UP,
+                                                  dv))).astype(jnp.uint8)
+                i = i - jnp.where((d == DIAG) | (d == UP), 1, 0)
+                j = j - jnp.where((d == DIAG) | (d == LEFT), 1, 0)
+                return (i, j), d
+
+            (_, _), rev = jax.lax.scan(
+                tstep, (a.astype(jnp.int32), bcol.astype(jnp.int32)),
+                None, length=steps)
+            return rev
+
+        return jax.vmap(one)(qb, tb, lqb, ltb)
+
+    fn = jax.shard_map(
+        block, mesh=mesh,
+        in_specs=(P("dp", None), P("dp", "sp"), P("dp"), P("dp")),
+        out_specs=P("dp", None), check_vma=False)
+    rev = fn(q, t, lq, lt)
+    n = jnp.sum(rev != PAD_OP, axis=1).astype(jnp.int32)
+    return jnp.flip(rev, axis=1), n
+
+
+def sp_nw_align(mesh: Mesh, q: np.ndarray, t: np.ndarray, lq: np.ndarray,
+                lt: np.ndarray, *, match: int, mismatch: int, gap: int):
+    """Sequence-parallel batched NW with full traceback.
+
+    Contract matches racon_tpu.ops.align.nw_align_batch: returns host
+    (ops uint8[B, Lq+Lt] right-aligned, n_ops int32[B]).
+
+    When to use (the long-window routing bound): a single chip's device
+    engine handles a window as long as its dirs tensor fits the int32
+    flat-index budget — at the minimum 128-job chunk that is
+    Lq*LA <= ~12.5e6, i.e. ~3.5 kb x 3.5 kb windows; the host path
+    (adaptive-band native aligner, unbounded) covers anything beyond on
+    one host. This sp path is the scale-out primitive past both: the
+    target axis shards over "sp" chips so per-chip dirs memory drops to
+    Lq*Lt/nsp, covering windows ~nsp x longer at the same budget. The
+    per-step psum walk costs one tiny collective per op (~2 us on ICI;
+    latency-bound, so reserve sp for windows that genuinely exceed a
+    chip).
+    """
+    qd, td, lqd, ltd, B = shard_align_inputs(mesh, q, t, lq, lt)
+    nsp = mesh.shape["sp"]
+    Lt = t.shape[1]
+    if Lt % nsp:
+        pad = (nsp - Lt % nsp)
+        td = jnp.concatenate(
+            [td, jnp.zeros((td.shape[0], pad), td.dtype)], axis=1)
+    ops, n = _sp_align_jit(qd, td, lqd, ltd, match=match,
+                           mismatch=mismatch, gap=gap, mesh=mesh)
+    W = ops.shape[1]
+    ops_h = np.asarray(ops)[:B]
+    n_h = np.asarray(n)[:B]
+    # Re-right-align to Lq+Lt width if target padding widened the walk.
+    want = q.shape[1] + Lt
+    if W != want:
+        from racon_tpu.ops.align import PAD_OP
+        out = np.full((B, want), PAD_OP, np.uint8)
+        for b in range(B):
+            out[b, want - n_h[b]:] = ops_h[b, W - n_h[b]:]
+        ops_h = out
+    return ops_h, n_h
