@@ -89,14 +89,20 @@ def main():
     eng = PoaEngine(backend=backend)
     eng.consensus_windows(build_windows(n_windows, coverage, wlen, seed=99))
 
+    # End-to-end: pipelined (chunk i+1's h2d overlaps chunk i's compute).
     windows = build_windows(n_windows, coverage, wlen)
-    stats = {}
     eng = PoaEngine(backend=backend)
-    eng.stats = stats
     t0 = time.perf_counter()
     n_polished = eng.consensus_windows(windows)
     dt = time.perf_counter() - t0
     assert n_polished == n_windows
+
+    # Phase split: a second identical run with stats syncs (serializes
+    # the pipeline so each phase is attributable).
+    stats = {}
+    eng2 = PoaEngine(backend=backend)
+    eng2.stats = stats
+    eng2.consensus_windows(build_windows(n_windows, coverage, wlen))
 
     # Sanity: consensus must actually polish (each window was built from a
     # 10%-error backbone; consensus should be near the truth, i.e. differ
@@ -107,15 +113,18 @@ def main():
     e2e = n_windows / dt
     compute_s = stats.get("compute", 0.0)
     compute = n_windows / compute_s if compute_s > 0 else e2e
+    # Chunk pipelining overlaps h2d/compute/d2h, so pipelined end-to-end
+    # is the real chip throughput (it can exceed the serialized
+    # compute-only rate); both are reported.
     print(json.dumps({
-        "metric": f"POA windows/sec/chip compute-only (w={wlen}, "
-                  f"{coverage}x cov, all refinement rounds on device, "
-                  f"backend={backend}:{dev}; end-to-end through the "
-                  "~30MB/s dev tunnel in extra keys)",
-        "value": round(compute, 2),
+        "metric": f"POA windows/sec/chip end-to-end, chunk-pipelined "
+                  f"(w={wlen}, {coverage}x cov, all refinement rounds on "
+                  f"device, backend={backend}:{dev}; serialized "
+                  "compute-only split in extra keys)",
+        "value": round(e2e, 2),
         "unit": "windows/s",
-        "vs_baseline": round(compute / CPU_64T_WINDOWS_PER_SEC, 3),
-        "end_to_end_windows_per_sec": round(e2e, 2),
+        "vs_baseline": round(e2e / CPU_64T_WINDOWS_PER_SEC, 3),
+        "compute_only_windows_per_sec": round(compute, 2),
         "n_windows": n_windows,
         "phase_seconds": {k: round(v, 3) for k, v in stats.items()
                           if isinstance(v, float)},

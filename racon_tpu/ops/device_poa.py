@@ -396,23 +396,20 @@ def _pack_out(codes, cov, alen, ovf):
     ])
 
 
-def run_chunk(plan: ChunkPlan, *, match: int, mismatch: int, gap: int,
-              ins_scale: float, rounds: int, stats: Optional[dict] = None,
-              mesh=None
-              ) -> Tuple[List[Optional[bytes]], List[Optional[np.ndarray]]]:
-    """Execute all refinement rounds for a chunk; one h2d, one d2h.
-
-    Returns (consensus codes bytes per window, coverage arrays). A window
-    whose consensus outgrew the padded anchor width (sticky ``ovf`` flag)
-    yields ``None`` in both lists — the caller must re-run it on the
-    unbounded host path instead of shipping a silently truncated string.
+def dispatch_chunk(plan: ChunkPlan, *, match: int, mismatch: int,
+                   gap: int, ins_scale: float, rounds: int,
+                   stats: Optional[dict] = None, mesh=None):
+    """Ship a chunk to the device and chain all refinement rounds —
+    returns the (still in-flight) packed output array. No host sync:
+    the caller may dispatch further chunks before collecting, so h2d of
+    chunk i+1 overlaps chunk i's compute.
 
     ``stats`` (optional dict) accumulates phase wall times under keys
     "h2d" / "compute" / "d2h" / "chunks". Phase edges force a tiny d2h
-    (jax.block_until_ready is a no-op on the axon backend), so collecting
-    stats adds two tunnel round-trips per chunk; production runs pass
-    None and pay nothing. RACON_TPU_TIMING=1 additionally prints each
-    refinement round's time to stderr.
+    (jax.block_until_ready is a no-op on the axon backend), so
+    collecting stats serializes the pipeline and adds two tunnel
+    round-trips per chunk; production runs pass None and pay nothing.
+    RACON_TPU_TIMING=1 additionally prints each round's time to stderr.
     """
     import os
     import sys
@@ -469,13 +466,29 @@ def run_chunk(plan: ChunkPlan, *, match: int, mismatch: int, gap: int,
         t0 = sync(cov, "compute", t0)
     if stats is not None:
         stats["chunks"] = stats.get("chunks", 0) + 1
+        stats["_t_pack"] = time.perf_counter()
 
-    # One synchronized pull: everything packed into a single uint8 buffer.
-    Nw, LA = plan.n_win, plan.LA
-    packed = _pack_out(bb[:-1], cov, alen[:-1], ovf)
+    return _pack_out(bb[:-1], cov, alen[:-1], ovf)
+
+
+def collect_chunk(plan: ChunkPlan, packed, stats: Optional[dict] = None
+                  ) -> Tuple[List[Optional[bytes]],
+                             List[Optional[np.ndarray]]]:
+    """Pull a dispatched chunk's packed output and unpack per window.
+
+    Returns (consensus codes bytes per window, coverage arrays). A
+    window whose consensus outgrew the padded anchor width (sticky
+    ``ovf`` flag) yields ``None`` in both lists — the caller must re-run
+    it on the unbounded host path instead of shipping a silently
+    truncated string.
+    """
+    import time
+
     ph = np.asarray(packed)
-    if collect:
-        t0 = sync(packed, "d2h", t0)
+    if stats is not None and "_t_pack" in stats:
+        stats["d2h"] = stats.get("d2h", 0.0) + \
+            (time.perf_counter() - stats.pop("_t_pack"))
+    Nw, LA = plan.n_win, plan.LA
     codes_h = ph[:Nw * LA].reshape(Nw, LA)
     cov_h = ph[Nw * LA:3 * Nw * LA].view(np.int16).reshape(Nw, LA)
     alen_h = ph[3 * Nw * LA:3 * Nw * LA + 4 * Nw].view(np.int32)[:Nw]
@@ -492,3 +505,14 @@ def run_chunk(plan: ChunkPlan, *, match: int, mismatch: int, gap: int,
         out_codes.append(codes_h[wi, :L].tobytes())
         out_cov.append(cov_h[wi, :L].astype(np.int32))
     return out_codes, out_cov
+
+
+def run_chunk(plan: ChunkPlan, *, match: int, mismatch: int, gap: int,
+              ins_scale: float, rounds: int, stats: Optional[dict] = None,
+              mesh=None
+              ) -> Tuple[List[Optional[bytes]], List[Optional[np.ndarray]]]:
+    """dispatch_chunk + collect_chunk, back to back (sequential form)."""
+    packed = dispatch_chunk(plan, match=match, mismatch=mismatch, gap=gap,
+                            ins_scale=ins_scale, rounds=rounds,
+                            stats=stats, mesh=mesh)
+    return collect_chunk(plan, packed, stats=stats)

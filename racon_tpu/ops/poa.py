@@ -216,9 +216,9 @@ class PoaEngine:
                           la_max: int) -> int:
         """Device-resident path: all refinement rounds on chip, one h2d /
         one d2h per chunk (racon_tpu/ops/device_poa.py)."""
-        from racon_tpu.ops.device_poa import (ChunkPlan, run_chunk,
-                                              run_caps, _bucket_b,
-                                              MAX_DIR_ELEMS)
+        from racon_tpu.ops.device_poa import (ChunkPlan, dispatch_chunk,
+                                              collect_chunk, run_caps,
+                                              _bucket_b, MAX_DIR_ELEMS)
         # One (Lq, LA) cap pair for the whole run (cap-history reuse):
         # every chunk shares a single compiled device_round executable
         # instead of paying a multi-second XLA compile per shape.
@@ -249,6 +249,29 @@ class PoaEngine:
         total_jobs = sum(w.n_layers for w in active)
         n_chunks = max(1, -(-total_jobs // jobs_cap))
         target = -(-total_jobs // n_chunks)
+        # Pipeline: chunk i+1's h2d + dispatch go out while chunk i
+        # still computes (depth 2 bounds in-flight HBM). Stats collection
+        # forces depth 0 (strictly sequential) so every phase time stays
+        # attributable to its chunk (the pack timestamp lives in the
+        # shared stats dict).
+        depth = 0 if self.stats is not None else 2
+        pending: List[Tuple[List[Window], object, object]] = []
+        trunc: List[Window] = []
+
+        def finish(entry) -> None:
+            ws, plan, packed = entry
+            codes, covs = collect_chunk(plan, packed, stats=self.stats)
+            for w, c, cv in zip(ws, codes, covs):
+                if c is None:
+                    # Consensus outgrew the chunk's padded anchor width
+                    # (sticky device ovf flag): the device result is
+                    # truncated; the host path is unbounded.
+                    trunc.append(w)
+                    continue
+                w.apply_consensus(
+                    decode_bases(np.frombuffer(c, dtype=np.uint8)), cv,
+                    log=self.log)
+
         i = 0
         while i < len(active):
             ws: List[Window] = []
@@ -261,27 +284,21 @@ class PoaEngine:
             plan = ChunkPlan(ws, lq_cap=lq_cap, la_cap=la_cap,
                              n_shards=(self.mesh.shape["dp"]
                                        if self.mesh is not None else 1))
-            codes, covs = run_chunk(
+            packed = dispatch_chunk(
                 plan, match=self.match, mismatch=self.mismatch,
                 gap=self.gap, ins_scale=self._eff_ins_scale,
                 rounds=self.refine_rounds + 1, stats=self.stats,
                 mesh=self.mesh)
-            trunc: List[Window] = []
-            for w, c, cv in zip(ws, codes, covs):
-                if c is None:
-                    # Consensus outgrew the chunk's padded anchor width
-                    # (sticky device ovf flag): the device result is
-                    # truncated; the host path is unbounded.
-                    trunc.append(w)
-                    continue
-                w.apply_consensus(
-                    decode_bases(np.frombuffer(c, dtype=np.uint8)), cv,
-                    log=self.log)
-            if trunc:
-                print(f"[racon_tpu::PoaEngine] {len(trunc)} window(s) "
-                      "outgrew the device anchor budget; re-polishing on "
-                      "the host path", file=self.log)
-                self._consensus_host(trunc, force_native=True)
+            pending.append((ws, plan, packed))
+            if len(pending) > depth:
+                finish(pending.pop(0))
+        for entry in pending:
+            finish(entry)
+        if trunc:
+            print(f"[racon_tpu::PoaEngine] {len(trunc)} window(s) "
+                  "outgrew the device anchor budget; re-polishing on "
+                  "the host path", file=self.log)
+            self._consensus_host(trunc, force_native=True)
         return len(active) + n_wide
 
     def _consensus_host(self, active: List[Window],

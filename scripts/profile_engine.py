@@ -42,8 +42,8 @@ def main():
     lq_cap, la_cap = run_caps(lq, la)
     plan = ChunkPlan(windows, lq_cap=lq_cap, la_cap=la_cap)
     print(f"backend={jax.default_backend()} B={plan.B} Lq={plan.Lq} "
-          f"LA={plan.LA} n_win={plan.n_win} steps={plan.steps}",
-        flush=True)
+          f"LA={plan.LA} W={plan.band_w} n_win={plan.n_win} "
+          f"steps={plan.steps}", flush=True)
     M, X, G, INS = 5, -4, -8, 0.3
 
     t0 = time.perf_counter()
@@ -67,20 +67,43 @@ def main():
         full = (b_c < offs) & (e_c > L - offs)
         t_off = jnp.where(full, 0, b_c).astype(jnp.int32)
         lt = jnp.where(full, L, e_c - b_c + 1).astype(jnp.int32)
-        x = jnp.arange(LA, dtype=jnp.int32)[None, :]
-        ok = x < lt[:, None]
         flat = bb.reshape(-1)
-        gidx = (win[:, None] * LA + jnp.clip(t_off[:, None] + x, 0, LA - 1))
-        tbuf = jnp.where(ok, jnp.take(flat, gidx), 7).astype(jnp.uint8)
-        if pallas:
-            from racon_tpu.ops.pallas.flat_kernel import fw_dirs_pallas
-            dirs = fw_dirs_pallas(tbuf, q.T, match=M, mismatch=X, gap=G)
+        band_w = plan.band_w
+        if band_w:
+            from racon_tpu.ops.pallas.band_kernel import (
+                fw_dirs_band, fw_dirs_band_xla, fw_traceback_band,
+                band_geometry)
+            klo, wl = band_geometry(lqv, lt, band_w)
+            y = jnp.arange(band_w + Lq, dtype=jnp.int32)[None, :]
+            rel = klo[:, None] + y
+            okb = (rel >= 0) & (rel < lt[:, None])
+            gidxb = (win[:, None] * LA +
+                     jnp.clip(t_off[:, None] + rel, 0, LA - 1))
+            tband = jnp.where(okb, jnp.take(flat, gidxb),
+                              7).astype(jnp.uint8)
+            fwd = fw_dirs_band if pallas else fw_dirs_band_xla
+            dirs, hlast = fwd(tband, q.T, klo, lqv, match=M, mismatch=X,
+                              gap=G, W=band_w)
+            if upto == "fw":
+                return jnp.sum(dirs, dtype=jnp.int32) + jnp.sum(hlast)
+            rev = fw_traceback_band(dirs, lqv, lt, klo, steps,
+                                    transposed=pallas)
         else:
-            dirs = flatmod.fw_dirs_xla(tbuf, q.T, match=M, mismatch=X,
-                                       gap=G)
-        if upto == "fw":
-            return jnp.sum(dirs, dtype=jnp.int32)
-        rev = flatmod.fw_traceback(dirs, lqv, lt, steps)
+            x = jnp.arange(LA, dtype=jnp.int32)[None, :]
+            ok = x < lt[:, None]
+            gidx = (win[:, None] * LA +
+                    jnp.clip(t_off[:, None] + x, 0, LA - 1))
+            tbuf = jnp.where(ok, jnp.take(flat, gidx), 7).astype(jnp.uint8)
+            if pallas:
+                from racon_tpu.ops.pallas.flat_kernel import fw_dirs_pallas
+                dirs = fw_dirs_pallas(tbuf, q.T, match=M, mismatch=X,
+                                      gap=G)
+            else:
+                dirs = flatmod.fw_dirs_xla(tbuf, q.T, match=M, mismatch=X,
+                                           gap=G)
+            if upto == "fw":
+                return jnp.sum(dirs, dtype=jnp.int32)
+            rev = flatmod.fw_traceback(dirs, lqv, lt, steps)
         ops = jnp.flip(rev, axis=1)
         if upto == "tb":
             return jnp.sum(ops, dtype=jnp.int32)
