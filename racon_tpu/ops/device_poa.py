@@ -88,6 +88,31 @@ def run_caps(lq: int, la: int) -> Tuple[int, int]:
     return best
 
 
+def window_band_delta(w: Window) -> int:
+    """Max |lt0 - lq| over a window's layers at round-0 geometry — THE
+    band-width input, shared by ChunkPlan (per chunk) and
+    PoaEngine._run_band_width (per run) so chunk sizing and chunk
+    padding can never disagree. Mirrors _round_core's on-device
+    full-span rule (src/window.cpp:82)."""
+    L = len(w.backbone)
+    if w.n_layers == 0:
+        return 0
+    offs = L // 100
+    b = np.clip(np.asarray(w.layer_begin, np.int64), 0, L - 1)
+    e = np.maximum(
+        np.minimum(np.asarray(w.layer_end, np.int64), L - 1), b)
+    lqs = np.array([len(d) for d in w.layer_data], np.int64)
+    full = (b < offs) & (e > L - offs)
+    lt0 = np.where(full, L, e - b + 1)
+    return int(np.abs(lt0 - lqs).max())
+
+
+def band_width_for(max_delta: int) -> int:
+    """Band slots covering a max length-difference with >=128 slack per
+    side, on the 128 grid."""
+    return _round_up(max_delta + 2 * 128 + 1, 128)
+
+
 def dir_elems(n_jobs: int, max_lq: int, max_bb: int) -> int:
     """Dirs-tensor element count for a chunk, with ChunkPlan's padding."""
     return (_bucket_b(n_jobs) * _round_up(max_lq, 128) *
@@ -105,7 +130,7 @@ class ChunkPlan:
 
     def __init__(self, windows: List[Window], la_grow: int = LA_GROW,
                  lq_cap: Optional[int] = None, la_cap: Optional[int] = None,
-                 n_shards: int = 1):
+                 n_shards: int = 1, band_cap: Optional[int] = None):
         self.windows = windows
         jobs_q: List[np.ndarray] = []
         jobs_w: List[np.ndarray] = []
@@ -177,15 +202,16 @@ class ChunkPlan:
         # round-0 |lt - lq| with >=128 slack each side (later rounds can
         # shift geometry — the in-round escape bound re-certifies every
         # lane every round). 0 disables banding when a band would not
-        # beat the full-width kernel. Mirrors _round_core's geometry.
-        L = self.alen[self.win]
-        b_c = np.clip(self.begin, 0, L - 1)
-        e_c = np.clip(self.end, b_c, L - 1)
-        offs = L // 100
-        fullspan = (b_c < offs) & (e_c > L - offs)
-        lt0 = np.where(fullspan, L, e_c - b_c + 1)
-        max_delta = int(np.abs(lt0 - self.lq).max()) if self.n_jobs else 0
-        W = _round_up(max_delta + 2 * 128 + 1, 128)
+        # beat the full-width kernel.
+        W = band_width_for(max((window_band_delta(w) for w in windows),
+                               default=0))
+        if band_cap is not None and W > band_cap:
+            # The caller sized chunks assuming banded dirs of at most
+            # band_cap columns from the same shared geometry; a wider
+            # chunk here would overflow the int32 dirs budget silently.
+            raise ValueError(
+                "[racon_tpu::ChunkPlan] band width exceeds the caller's "
+                f"sizing cap ({W} > {band_cap})")
         if W + 128 > LA:
             # Band would not beat full width here; don't record W either,
             # or an unusable entry could shadow smaller fitting widths
@@ -193,12 +219,15 @@ class ChunkPlan:
             self.band_w = 0
         else:
             # Reuse a previously-compiled band width when one covers
-            # this chunk within 2x *and still fits this LA* (band_w is a
-            # static arg; workload noise across runs must not force
-            # fresh multi-second compiles).
+            # this chunk within 2x, fits this LA, and stays under the
+            # caller's ceiling (chunk sizing may have assumed banded
+            # dirs of at most band_cap columns). band_w is a static arg;
+            # workload noise across runs must not force fresh
+            # multi-second compiles.
+            ceil = min(LA - 128, band_cap) if band_cap else LA - 128
             best = None
             for c in _BAND_HISTORY:
-                if (W <= c <= 2 * W and c + 128 <= LA and
+                if (W <= c <= 2 * W and c <= ceil and
                         (best is None or c < best)):
                     best = c
             if best is None:

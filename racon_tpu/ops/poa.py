@@ -223,11 +223,20 @@ class PoaEngine:
         # every chunk shares a single compiled device_round executable
         # instead of paying a multi-second XLA compile per shape.
         lq_cap, la_cap = run_caps(lq_max, la_max)
+        # The dirs tensor that actually bounds chunk size is banded
+        # (B x Lq x W) whenever every chunk will band: size chunks by
+        # the run-level band width then, not the full LA — about 2x more
+        # jobs per dispatch at w=500 geometry.
+        import os as _os
+        band_off = (_os.environ.get("RACON_TPU_NO_BAND", "")
+                    not in ("", "0", "false"))
+        w_run = self._run_band_width(active, la_cap)
+        dirs_cols = la_cap if (band_off or not w_run) else w_run
         jobs_cap = self.device_batch
         while jobs_cap > 128 and \
-                _bucket_b(jobs_cap) * lq_cap * la_cap > MAX_DIR_ELEMS:
+                _bucket_b(jobs_cap) * lq_cap * dirs_cols > MAX_DIR_ELEMS:
             jobs_cap //= 2
-        if _bucket_b(jobs_cap) * lq_cap * la_cap > MAX_DIR_ELEMS:
+        if _bucket_b(jobs_cap) * lq_cap * dirs_cols > MAX_DIR_ELEMS:
             # Even a minimum-bucket chunk overflows the int32 flat-index
             # range at these caps (pathological mixed geometry): host path.
             print(f"[racon_tpu::PoaEngine] run geometry (Lq={lq_cap}, "
@@ -283,7 +292,8 @@ class PoaEngine:
                 i += 1
             plan = ChunkPlan(ws, lq_cap=lq_cap, la_cap=la_cap,
                              n_shards=(self.mesh.shape["dp"]
-                                       if self.mesh is not None else 1))
+                                       if self.mesh is not None else 1),
+                             band_cap=w_run or None)
             packed = dispatch_chunk(
                 plan, match=self.match, mismatch=self.mismatch,
                 gap=self.gap, ins_scale=self._eff_ins_scale,
@@ -300,6 +310,18 @@ class PoaEngine:
                   "the host path", file=self.log)
             self._consensus_host(trunc, force_native=True)
         return len(active) + n_wide
+
+    @staticmethod
+    def _run_band_width(active: List[Window], la_cap: int) -> int:
+        """Run-level band width (0 when banding will not engage): the
+        same shared geometry ChunkPlan uses per chunk
+        (device_poa.window_band_delta / band_width_for), evaluated over
+        the whole run so chunk sizing can assume banded dirs."""
+        from racon_tpu.ops.device_poa import (window_band_delta,
+                                              band_width_for)
+        W = band_width_for(max((window_band_delta(w) for w in active),
+                               default=0))
+        return W if W + 128 <= la_cap else 0
 
     def _consensus_host(self, active: List[Window],
                         force_native: bool = False) -> int:
