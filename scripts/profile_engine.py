@@ -6,6 +6,10 @@ jitting progressively larger prefixes of the round and blocking on a
 scalar consume of the result. Prints one line per stage.
 
 Usage: python scripts/profile_engine.py [n_windows] [coverage]
+       RACON_TPU_TRACE=/tmp/racon_trace python scripts/profile_engine.py
+           ... additionally captures a jax.profiler trace of one full
+           engine run (view with tensorboard/xprof) — the in-repo
+           re-measurement harness for the tracing subsystem.
 """
 
 import os
@@ -129,6 +133,15 @@ def main():
         dt = t(stage, *args, upto=upto)
         print(f"{upto:6s}: {dt:.3f}s (+{dt - prev:.3f}s)", flush=True)
         prev = dt
+
+    trace_dir = os.environ.get("RACON_TPU_TRACE")
+    if trace_dir:
+        from racon_tpu.ops.poa import PoaEngine
+        eng = PoaEngine(backend="jax")
+        eng.consensus_windows(build_windows(n, cov, 500, seed=1))  # warm
+        with jax.profiler.trace(trace_dir):
+            eng.consensus_windows(windows)
+        print(f"jax.profiler trace written to {trace_dir}", flush=True)
 
 
 if __name__ == "__main__":

@@ -156,3 +156,26 @@ def test_consensus_edit_distance_scoring(ref_data_module, reference_genome):
     out = _polish(ref_data_module, "sample_reads.fastq.gz",
                   "sample_overlaps.paf.gz", scores=(1, -1, -1))
     _check(out, reference_genome, 1321, 1300)
+
+
+@pytest.mark.ava
+def test_consensus_device_engine_golden_sam_fastq(ref_data_module,
+                                                  reference_genome):
+    """The flagship device-resident engine through the full reference
+    acceptance config (SAM+FASTQ, racon_test.cpp:131-151, golden 1317).
+
+    Measured 2026-07-30: ED 1305 on both the real TPU and the CPU XLA
+    backend (bit-identical engines) — beats the reference golden. Runs
+    ~5-6 min on one CPU core, hence opt-in (-m ava); the default suite
+    covers the same engine differentially on small windows.
+    """
+    from racon_tpu.models.polisher import create_polisher
+    p = create_polisher(
+        ref_data_module("sample_reads.fastq.gz"),
+        ref_data_module("sample_overlaps.sam.gz"),
+        ref_data_module("sample_layout.fasta.gz"), PolisherType.kC,
+        500, 10.0, 0.3, 5, -4, -8, backend="jax")
+    p.initialize()
+    out = p.polish(True)
+    ed = _edit_distance(reverse_complement(out[0].data), reference_genome)
+    assert ed <= 1317, f"device engine ED {ed} vs reference golden 1317"
