@@ -24,6 +24,8 @@ import io
 import os
 from typing import Iterator, List, Optional, Tuple
 
+import numpy as np
+
 from racon_tpu.models.sequence import Sequence
 from racon_tpu.models.overlap import Overlap
 
@@ -176,6 +178,16 @@ class FastqParser(Parser):
                 if len(quality) != len(data):
                     raise ParseError(
                         f"[racon_tpu::io] error: quality length mismatch in {self.path}"
+                    )
+                # Phred bytes below '!' (33) would decode to negative
+                # weights; reject here so every downstream consumer (host
+                # and device consensus paths) can assume weights >= 0 by
+                # construction instead of each clipping differently.
+                if quality and int(
+                        np.frombuffer(quality, np.uint8).min()) < 33:
+                    raise ParseError(
+                        f"[racon_tpu::io] error: malformed quality string "
+                        f"(byte below '!') in {self.path}"
                     )
                 yield Sequence(name.decode(), data, quality), len(name) + 2 * len(data)
 

@@ -17,9 +17,14 @@ engine keeps the whole refinement loop on device:
   d2h once:  compact consensus codes + coverage + lengths + edge stats
 
 Semantics match PoaEngine's numpy path bit-for-bit on integer weights
-(differentially tested); the banded alignment equals the native adaptive
-aligner's first pass wherever the traceback stays off the artificial band
-edge (flagged lanes are counted and reported).
+(differentially tested) on a single device. Banded-alignment exactness is
+certified per lane every round by an escape-bound score check (see
+racon_tpu/ops/pallas/band_kernel.py): a lane whose banded score cannot
+provably beat every band-leaving path flags its window for re-polish on
+the unbounded host path. The dp-sharded path (device_round_sharded) is
+near-bit-identical to single-device: its one psum may reassociate f32
+vote sums, so sub-epsilon ties can break differently (tests accept rare
+single-window divergence; see tests/test_device_merge.py).
 """
 
 from __future__ import annotations
@@ -57,7 +62,11 @@ def _bucket_b(n: int) -> int:
 # runs pad up to a previously-compiled (Lq, LA) pair when one covers them
 # within 2x per dim (beyond that, recompiling is cheaper than the padded
 # compute). jax's executable cache keys on the same shapes, so a history
-# hit is a compile-cache hit.
+# hit is a compile-cache hit. Mutations are lock-guarded: concurrent
+# PoaEngine use from multiple threads would otherwise race the sets
+# (worst case a missed reuse => redundant compile, never wrong results).
+import threading as _threading
+_HISTORY_LOCK = _threading.Lock()
 _CAP_HISTORY: set = set()
 _BAND_HISTORY: set = set()
 
@@ -75,17 +84,18 @@ def run_caps(lq: int, la: int) -> Tuple[int, int]:
         # the host path) — don't record it, or it would shadow smaller
         # usable pairs for later runs.
         return need
-    best = None
-    for c in _CAP_HISTORY:
-        if (need[0] <= c[0] <= 2 * need[0] and
-                need[1] <= c[1] <= 2 * need[1] and
-                128 * c[0] * c[1] <= MAX_DIR_ELEMS and
-                (best is None or c[0] * c[1] < best[0] * best[1])):
-            best = c
-    if best is None:
-        best = need
-        _CAP_HISTORY.add(need)
-    return best
+    with _HISTORY_LOCK:
+        best = None
+        for c in _CAP_HISTORY:
+            if (need[0] <= c[0] <= 2 * need[0] and
+                    need[1] <= c[1] <= 2 * need[1] and
+                    128 * c[0] * c[1] <= MAX_DIR_ELEMS and
+                    (best is None or c[0] * c[1] < best[0] * best[1])):
+                best = c
+        if best is None:
+            best = need
+            _CAP_HISTORY.add(need)
+        return best
 
 
 def window_band_delta(w: Window) -> int:
@@ -178,8 +188,11 @@ class ChunkPlan:
         for b in range(self.n_jobs):
             ql = len(jobs_q[b])
             self.q[b, :ql] = jobs_q[b]
-            # Clip before the uint8 encode: malformed quality below '!'
-            # would otherwise wrap to a huge device weight.
+            # Weights are non-negative for all parser-fed inputs (the FASTQ
+            # parser rejects quality bytes below '!'), so host and device
+            # paths agree by construction on CLI data. The clip stays as
+            # defense-in-depth for direct-API Windows built with malformed
+            # quality, where uint8 wrap would otherwise vote at max weight.
             self.qw8[b, :ql] = np.clip(jobs_w[b], 0, 254).astype(np.uint8) + 1
             self.lq[b] = ql
             self.w_read[b] = float(jobs_w[b].astype(np.float64).mean()) \
@@ -225,14 +238,15 @@ class ChunkPlan:
             # workload noise across runs must not force fresh
             # multi-second compiles.
             ceil = min(LA - 128, band_cap) if band_cap else LA - 128
-            best = None
-            for c in _BAND_HISTORY:
-                if (W <= c <= 2 * W and c <= ceil and
-                        (best is None or c < best)):
-                    best = c
-            if best is None:
-                _BAND_HISTORY.add(W)
-                best = W
+            with _HISTORY_LOCK:
+                best = None
+                for c in _BAND_HISTORY:
+                    if (W <= c <= 2 * W and c <= ceil and
+                            (best is None or c < best)):
+                        best = c
+                if best is None:
+                    _BAND_HISTORY.add(W)
+                    best = W
             self.band_w = best
 
 

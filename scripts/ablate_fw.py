@@ -1,0 +1,183 @@
+"""Ablation microbench of the banded forward kernel (real TPU).
+
+Times kernel variants that each remove one cost component, all at bench
+shapes (B=3072, Lq=640, W=384), using in-program deltas (chained reps of
+the jitted call with a single scalar d2h at the end to sync — per
+PROFILE.md, single-call timings through the axon tunnel are meaningless).
+
+Variants:
+  base       — the production kernel (band_kernel._kernel)
+  noladder   — shift-max ladder removed (h = max(diag, up) only; WRONG
+               results, cost ablation only)
+  ladder3    — ladder truncated to 3 passes (max chain 8; WRONG)
+  nodirs     — dirs computed but not stored (only hlast out; WRONG)
+  notw       — target window slice hoisted (same row every time; WRONG)
+  i16        — int16 scores end to end
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from racon_tpu.ops.cigar import DIAG, UP, LEFT
+
+_NEG = -(2 ** 30)
+_NEG16 = -(2 ** 13)
+TB = 128
+CH = 32
+
+
+def make_kernel(*, match, mismatch, gap, W, ladder_passes, store_dirs,
+                dyn_tw, dtype):
+    NEG = _NEG16 if dtype == jnp.int16 else _NEG
+
+    def _kernel(tbandT_ref, qT_ref, klo_ref, lq_ref, dirs_ref, hlast_ref,
+                prev_ref):
+        c = pl.program_id(1)
+        xr = jax.lax.broadcasted_iota(jnp.int32, (W, TB), 0)
+        klo = klo_ref[0]
+        lqv = lq_ref[0]
+
+        @pl.when(c == 0)
+        def _():
+            j0 = klo[None, :] + xr
+            init = jnp.where(j0 >= 0, j0 * gap, NEG).astype(dtype)
+            prev_ref[:] = init
+            hlast_ref[:] = init
+
+        def row(r, _):
+            i = c * CH + r + 1
+            qrow = qT_ref[r]
+            if dyn_tw:
+                tw = tbandT_ref[pl.dslice(i - 1, W), :]
+            else:
+                tw = tbandT_ref[pl.dslice(0, W), :]
+            jcol = i + klo[None, :] + xr
+            sub = jnp.where(tw == qrow[None, :], match, mismatch)
+            sub = jnp.where(jcol >= 1, sub, NEG).astype(dtype)
+            P = prev_ref[:]
+            diag = P + sub
+            up = jnp.concatenate(
+                [P[1:, :], jnp.full((1, TB), NEG, dtype)], axis=0) + \
+                dtype(gap)
+            tmp = jnp.maximum(diag, up)
+            tmp = jnp.where(jcol == 0, (i * gap), tmp).astype(dtype)
+            jg = (jcol * gap).astype(dtype)
+            f = tmp - jg
+            s = 1
+            passes = 0
+            while s < W and passes < ladder_passes:
+                f = jnp.maximum(
+                    f, jnp.concatenate(
+                        [jnp.full((s, TB), NEG // 2, dtype), f[:-s, :]],
+                        axis=0))
+                s *= 2
+                passes += 1
+            h = f + jg
+            h = jnp.where(jcol >= 0, h, NEG).astype(dtype)
+            h = jnp.maximum(h, NEG)
+            d = jnp.where(h == diag, DIAG,
+                          jnp.where(h == up, UP, LEFT)).astype(jnp.uint8)
+            if store_dirs:
+                dirs_ref[r] = d
+            prev_ref[:] = h
+            hlast_ref[:] = jnp.where((lqv == i)[None, :], h, hlast_ref[:])
+            return 0
+
+        jax.lax.fori_loop(0, CH, row, 0)
+
+    return _kernel
+
+
+def build_fw(*, B, Lq, W, match, mismatch, gap, ladder_passes=99,
+             store_dirs=True, dyn_tw=True, dtype=jnp.int32):
+    kernel = make_kernel(match=match, mismatch=mismatch, gap=gap, W=W,
+                         ladder_passes=ladder_passes, store_dirs=store_dirs,
+                         dyn_tw=dyn_tw, dtype=dtype)
+
+    @jax.jit
+    def fw(tband, qT, klo, lq):
+        dirs, hlast = pl.pallas_call(
+            kernel,
+            grid=(B // TB, Lq // CH),
+            in_specs=[
+                pl.BlockSpec((W + Lq, TB), lambda b, c: (0, b),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((CH, TB), lambda b, c: (c, b),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, TB), lambda b, c: (0, b),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, TB), lambda b, c: (0, b),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=[
+                pl.BlockSpec((CH, W, TB), lambda b, c: (c, 0, b),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((W, TB), lambda b, c: (0, b),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((Lq, W, B), jnp.uint8),
+                jax.ShapeDtypeStruct((W, B), dtype),
+            ],
+            scratch_shapes=[pltpu.VMEM((W, TB), dtype)],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("arbitrary", "arbitrary")),
+        )(tband.astype(dtype).T, qT.astype(dtype), klo[None, :],
+          lq[None, :])
+        # consume: tiny reduction so only a scalar syncs
+        return jnp.sum(hlast.astype(jnp.int32)) + jnp.sum(
+            dirs[::97, ::31, ::53].astype(jnp.int32))
+
+    return fw
+
+
+def timeit(fn, args, reps=4):
+    out = fn(*args)
+    np.asarray(out)          # compile + sync
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    np.asarray(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    B, Lq, W = 3072, 640, 384
+    M, X, G = 5, -4, -8
+    rng = np.random.default_rng(0)
+    tband = rng.integers(0, 4, (B, W + Lq)).astype(np.uint8)
+    qT = rng.integers(0, 4, (Lq, B)).astype(np.uint8)
+    klo = np.full(B, -192, np.int32)
+    lq = np.full(B, 500, np.int32)
+    args = (jnp.asarray(tband), jnp.asarray(qT), jnp.asarray(klo),
+            jnp.asarray(lq))
+
+    variants = [
+        ("base", dict()),
+        ("nodirs", dict(store_dirs=False)),
+        ("noladder", dict(ladder_passes=0)),
+        ("ladder3", dict(ladder_passes=3)),
+        ("notw", dict(dyn_tw=False)),
+        ("i16", dict(dtype=jnp.int16)),
+        ("i16+ladder3", dict(dtype=jnp.int16, ladder_passes=3)),
+        ("i16+nodirs", dict(dtype=jnp.int16, store_dirs=False)),
+    ]
+    for name, kw in variants:
+        fw = build_fw(B=B, Lq=Lq, W=W, match=M, mismatch=X, gap=G, **kw)
+        dt = timeit(fw, args)
+        cells = B * Lq * W
+        print(f"{name:14s}: {dt * 1e3:7.1f} ms   "
+              f"{cells / dt / 1e9:6.1f} Gcell/s", flush=True)
+
+
+if __name__ == "__main__":
+    main()

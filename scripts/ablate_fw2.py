@@ -1,0 +1,196 @@
+"""Second-level ablation: where do the non-kernel forward costs live?
+
+Times, with chained reps + scalar consume:
+  tband   — the pre-shifted target gather build (take over flat anchors)
+  kernel  — fw_dirs_band alone (production kernel)
+  k+tb    — kernel + banded traceback
+  sumdirs — kernel + jnp.sum(dirs) (profile_engine's consume, to correct
+            its stage attribution)
+  votes sub-stages — cumsums / count / gathers 1-4 / channels, each as a
+            prefix of extract_votes.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from racon_tpu.ops.pallas.band_kernel import (fw_dirs_band,
+                                              fw_traceback_band)
+from racon_tpu.ops.flat import PAD_OP
+from racon_tpu.ops.cigar import UP, LEFT
+
+
+def timeit(fn, *args, reps=4):
+    out = fn(*args)
+    jax.tree.map(np.asarray, out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.tree.map(np.asarray, out)
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    B, Lq, W, LA = 3072, 640, 384, 768
+    steps = Lq + LA
+    M, X, G = 5, -4, -8
+    n_win = 96
+    rng = np.random.default_rng(0)
+    flat = jnp.asarray(rng.integers(0, 4, (n_win + 1) * LA).astype(np.uint8))
+    win = jnp.asarray(np.repeat(np.arange(n_win + 1), 32)[:B].astype(np.int32))
+    t_off = jnp.zeros(B, jnp.int32)
+    klo = jnp.full(B, -192, jnp.int32)
+    lq = jnp.full(B, 500, jnp.int32)
+    lt = jnp.full(B, 500, jnp.int32)
+    qT = jnp.asarray(rng.integers(0, 4, (Lq, B)).astype(np.uint8))
+
+    @jax.jit
+    def build_tband():
+        y = jnp.arange(W + Lq, dtype=jnp.int32)[None, :]
+        rel = klo[:, None] + y
+        okb = (rel >= 0) & (rel < lt[:, None])
+        gidxb = (win[:, None] * LA + jnp.clip(t_off[:, None] + rel, 0,
+                                              LA - 1))
+        return jnp.where(okb, jnp.take(flat, gidxb), 7).astype(jnp.uint8)
+
+    tband = build_tband()
+    np.asarray(tband)
+
+    print(f"tband build : {timeit(lambda: jnp.sum(build_tband(), dtype=jnp.int32)) * 1e3:7.1f} ms", flush=True)
+
+    @jax.jit
+    def kern(tband):
+        dirs, hlast = fw_dirs_band(tband, qT, klo, lq, match=M, mismatch=X,
+                                   gap=G, W=W)
+        return jnp.sum(hlast) + jnp.sum(dirs[0, 0].astype(jnp.int32))
+
+    print(f"kernel      : {timeit(kern, tband) * 1e3:7.1f} ms", flush=True)
+
+    @jax.jit
+    def kern_tb(tband):
+        dirs, hlast = fw_dirs_band(tband, qT, klo, lq, match=M, mismatch=X,
+                                   gap=G, W=W)
+        rev = fw_traceback_band(dirs, lq, lt, klo, steps, transposed=True)
+        return jnp.sum(rev, dtype=jnp.int32) + jnp.sum(hlast)
+
+    print(f"kernel+tb   : {timeit(kern_tb, tband) * 1e3:7.1f} ms", flush=True)
+
+    @jax.jit
+    def kern_tb_flip(tband):
+        dirs, hlast = fw_dirs_band(tband, qT, klo, lq, match=M, mismatch=X,
+                                   gap=G, W=W)
+        rev = fw_traceback_band(dirs, lq, lt, klo, steps, transposed=True)
+        ops = jnp.flip(rev, axis=1)
+        return jnp.sum(ops[:, 0], dtype=jnp.int32) + jnp.sum(hlast)
+
+    print(f"k+tb+flip   : {timeit(kern_tb_flip, tband) * 1e3:7.1f} ms",
+          flush=True)
+
+    @jax.jit
+    def kern_sum(tband):
+        dirs, hlast = fw_dirs_band(tband, qT, klo, lq, match=M, mismatch=X,
+                                   gap=G, W=W)
+        return jnp.sum(dirs, dtype=jnp.int32) + jnp.sum(hlast)
+
+    print(f"kernel+sumd : {timeit(kern_sum, tband) * 1e3:7.1f} ms",
+          flush=True)
+
+    # ---- extract_votes sub-stages ----------------------------------------
+    rev = np.asarray(jax.jit(lambda tb: fw_traceback_band(
+        fw_dirs_band(tb, qT, klo, lq, match=M, mismatch=X, gap=G, W=W)[0],
+        lq, lt, klo, steps, transposed=True))(tband))
+    ops = jnp.asarray(np.flip(rev, axis=1))
+    q = jnp.asarray(np.asarray(qT).T.copy())
+    qw = jnp.asarray(rng.integers(8, 25, (B, Lq)).astype(np.float32))
+    w_read = jnp.asarray(np.full(B, 15.0, np.float32))
+
+    from racon_tpu.ops.pallas.count_kernel import monotone_count_pallas
+
+    S = ops.shape[1]
+
+    def votes_prefix(upto):
+        @jax.jit
+        def f(ops, q, qw):
+            valid = ops != PAD_OP
+            tcons = valid & (ops != UP)
+            qcons = valid & (ops != LEFT)
+            ct = jnp.cumsum(tcons, axis=1, dtype=jnp.int32)
+            cq = jnp.cumsum(qcons, axis=1, dtype=jnp.int32)
+            ct_excl = ct - tcons
+            cq_excl = cq - qcons
+            X_ = jnp.where(valid, ct_excl, -1)
+            if upto == "cumsum":
+                return (jnp.sum(X_[:, 0]) + jnp.sum(cq_excl[:, 0]))
+            Xs = X_ + t_off[:, None]
+            F = monotone_count_pallas(Xs, LA + 2)
+            if upto == "count":
+                return jnp.sum(F[:, 0]) + jnp.sum(cq_excl[:, 0])
+            ops32 = ops.astype(jnp.int32)
+            stack_s = jnp.stack(
+                [jnp.concatenate([cq_excl, cq_excl[:, -1:]], axis=1),
+                 jnp.concatenate([cq_excl[:, :1], cq_excl], axis=1),
+                 jnp.concatenate([ops32[:, :1], ops32], axis=1)],
+                axis=-1)
+            G1 = jnp.take_along_axis(
+                stack_s, jnp.clip(F, 0, S)[:, :, None], axis=1)
+            if upto == "g1":
+                return jnp.sum(G1[:, 0], dtype=jnp.float32).astype(jnp.int32)
+            qstart = G1[:, :-1, 0]
+            qi = G1[:, 1:, 1]
+            stack_qi = jnp.stack([q.astype(jnp.float32), qw], axis=-1)
+            Gqi = jnp.take_along_axis(
+                stack_qi, jnp.clip(qi, 0, Lq - 1)[:, :, None], axis=1)
+            if upto == "g2":
+                return jnp.sum(Gqi[:, 0]).astype(jnp.int32)
+            from racon_tpu.ops.device_merge import K_INS
+            qwcum = jnp.concatenate(
+                [jnp.zeros((B, 1), jnp.float32), jnp.cumsum(qw, axis=1)],
+                axis=1)
+            qx = q.astype(jnp.int32)
+            qx_pad = jnp.concatenate(
+                [qx, jnp.repeat(qx[:, -1:], K_INS - 1, axis=1)], axis=1)
+            qw_pad = jnp.concatenate(
+                [qw, jnp.repeat(qw[:, -1:], K_INS - 1, axis=1)], axis=1)
+            chans = ([qx_pad[:, k:k + Lq].astype(jnp.float32)
+                      for k in range(K_INS)] +
+                     [qw_pad[:, k:k + Lq] for k in range(K_INS)] +
+                     [qwcum[:, :Lq]])
+            stack_qs = jnp.stack(chans, axis=-1)
+            Gqs = jnp.take_along_axis(
+                stack_qs, jnp.clip(qstart, 0, Lq - 1)[:, :, None], axis=1)
+            return jnp.sum(Gqs[:, 0]).astype(jnp.int32)
+        return f
+
+    for upto in ("cumsum", "count", "g1", "g2", "g3"):
+        dt = timeit(votes_prefix(upto), ops, q, qw)
+        print(f"votes/{upto:7s}: {dt * 1e3:7.1f} ms", flush=True)
+
+    # full extract_votes for reference
+    from racon_tpu.ops import device_merge as dm
+
+    @jax.jit
+    def votes_full(ops, q, qw):
+        v = dm.extract_votes(ops, q, qw, w_read, lt, t_off, LA, pallas=True)
+        return sum(jnp.sum(x[:, 0]) for x in v.values()).astype(jnp.int32)
+
+    print(f"votes/full   : {timeit(votes_full, ops, q, qw) * 1e3:7.1f} ms",
+          flush=True)
+
+    @jax.jit
+    def votes_agg(ops, q, qw):
+        v = dm.extract_votes(ops, q, qw, w_read, lt, t_off, LA, pallas=True)
+        acc = dm.aggregate_votes(v, win, n_win + 1)
+        return sum(jnp.sum(x[:1]) for x in acc.values()).astype(jnp.int32)
+
+    print(f"votes+agg    : {timeit(votes_agg, ops, q, qw) * 1e3:7.1f} ms",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -42,6 +42,16 @@ def test_fastq_quality_and_all_bang(tmp_path):
     assert seqs[1].quality is None
 
 
+def test_fastq_malformed_quality_rejected(tmp_path):
+    # Quality bytes below '!' would decode to negative Phred weights;
+    # the parser rejects them so host and device consensus paths can
+    # assume non-negative weights by construction.
+    p = tmp_path / "bad.fastq"
+    p.write_bytes(b"@r1\nACGT\n+\nII I\n")  # 0x20 < '!'
+    with pytest.raises(ParseError, match="malformed quality"):
+        FastqParser(str(p)).parse_all()
+
+
 def test_chunked_parse(tmp_path):
     p = tmp_path / "x.fasta"
     p.write_text("".join(f">s{i}\n{'ACGT' * 100}\n" for i in range(10)))
