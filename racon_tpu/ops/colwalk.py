@@ -1,0 +1,131 @@
+"""Column-walk traceback: per-anchor-position vote channels in one pass.
+
+The legacy traceback (flat.fw_traceback / band_kernel.fw_traceback_band)
+walks the alignment op by op — Lq + LA dependent steps, each paying an
+XLA gather dispatch — and hands a [B, steps] op string to extract_votes,
+which then needs a flip, two full cumsums, a counting kernel and stacked
+gathers to re-key ops by target column (PROFILE.md round-5 measurements:
+~150 ms/round of the ~380 ms total, all of it XLA gather/cumsum
+dispatch overhead, not arithmetic).
+
+This walk exploits the block structure of a global alignment: in forward
+order the ops partition into blocks ``[UP run at gap j][DIAG/LEFT
+consuming column j]``. The forward kernels pack, per DP cell,
+
+    byte = dir | consumer_dir << 2 | up_run << 4
+
+where ``up_run`` is the (saturating) length of the consecutive-UP chain
+ending at the cell and ``consumer_dir`` is the direction of the first
+non-UP cell above that chain — both propagate down the chain inside the
+forward kernel for a few extra vector ops per row. One packed-byte read
+per anchor position then undoes a whole block.
+
+The scan runs directly on the anchor-position grid p = 0..LA+1
+(``reverse=True`` so ys land at their p rows with no flip): each lane
+activates while j = p - t_off is inside [0, lt] and undoes gap j plus
+the consumer of column j-1 in that step. Emissions are therefore already
+keyed by anchor position — extract_votes_cols consumes them with zero
+re-keying gathers.
+
+Exactness: ``up_run`` saturates at U_SAT (15). An optimal NW path with a
+>=15-base insertion run costs >= 15*|gap| — essentially impossible on
+polishing windows — but correctness does not rest on that: saturated
+lanes raise a sticky flag and their windows are re-polished on the
+unbounded host path (the same redo route as the band escape bound).
+``consumer_dir`` propagates unsaturated, and a chain that reaches row 0
+stores LEFT — exactly the i==0 forced-LEFT walk of the legacy traceback
+(top-row deletions, reference edlib semantics at src/overlap.cpp:198).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from racon_tpu.ops.cigar import DIAG, UP, LEFT  # noqa: F401 (UP: doc)
+from racon_tpu.ops.flat import PAD_OP, U_SAT
+
+
+def col_walk(cells, lq, lt, klo, t_off, *, LA: int, layout: str):
+    """Walk packed cells over the anchor-position grid.
+
+    Args:
+      cells: uint8 packed-cell tensor from a forward kernel.
+      lq, lt: int32[B] per-lane query / target lengths.
+      klo: int32[B] band origin (band layouts) or None (flat).
+      t_off: int32[B] anchor offset of each lane's target slice.
+      LA: static anchor padding length; the scan runs LA + 2 steps.
+      layout: "band_t" [Lq, W, B] (Pallas band), "band" [Lq, B, W]
+        (XLA band twin), "flat" [Lq, B, Lt] (both flat kernels).
+
+    Returns dict of anchor-indexed arrays (all [B, LA+2] int16 except
+    ``sat`` bool[B]); row p describes the walk step at j = p - t_off:
+      ins_len[p] — insertion-run length at gap j
+      qstart[p]  — query index of the first inserted base at gap j
+      op_c[p]    — direction consuming column j - 1 (PAD_OP at j == 0)
+      qi_c[p]    — exclusive query-consumed count of that consumer
+      sat        — True where a saturated up_run made the walk inexact;
+                   the caller must re-polish those lanes' windows on the
+                   host path.
+    """
+    if layout == "band_t":
+        Lq, W, B = cells.shape
+    elif layout == "band":
+        Lq, B, W = cells.shape
+    else:
+        Lq, B, W = cells.shape           # W = Lt for flat layouts
+    c1 = cells.reshape(-1)
+    lane = jnp.arange(B, dtype=jnp.int32)
+    lt = lt.astype(jnp.int32)
+    lq = lq.astype(jnp.int32)
+    t_off = t_off.astype(jnp.int32)
+
+    def step(carry, p):
+        i, sat = carry
+        j = p - t_off
+        active = (j >= 0) & (j <= lt)
+        jc = jnp.clip(j, 0, lt)
+        # Packed byte of cell (i, j): row i-1 of the stored tensor.
+        r = jnp.maximum(i - 1, 0)
+        if layout == "flat":
+            col = jnp.maximum(jc - 1, 0)
+            idx = r * (B * W) + lane * W + col
+        else:
+            x = jnp.clip(jc - i - klo, 0, W - 1)
+            if layout == "band_t":
+                idx = r * (B * W) + x * B + lane
+            else:
+                idx = r * (B * W) + lane * W + x
+        pv = jnp.take(c1, idx).astype(jnp.int32)
+        readable = active & (i >= 1) & (jc >= 1)
+        u = jnp.where(readable, pv >> 4, 0)
+        cdir = jnp.where(readable, (pv >> 2) & 3, LEFT)
+        newsat = readable & (u == U_SAT)
+        is_j0 = active & (j == 0)
+        # Gap j: the whole UP run in one step; at j == 0 every remaining
+        # query base is a leading insertion (legacy walk's j==0 forcing).
+        # That run is exact (no cell read) but extract_votes_cols' window
+        # channels only span U_SAT weights, so longer leading runs must
+        # take the same redo route as saturated cells.
+        newsat = newsat | (is_j0 & (i > U_SAT))
+        u_eff = jnp.where(is_j0, i, u)
+        top = i - u_eff
+        cons = jnp.where(top <= 0, LEFT, cdir)
+        cons = jnp.where(is_j0, PAD_OP, cons)
+        qi = top - jnp.where(cons == DIAG, 1, 0)
+        i_next = jnp.where(active, jnp.where(is_j0, 0, qi), i)
+        sat = sat | newsat
+        # ONE stacked int16 ys, not a tuple of int16 arrays: a reverse
+        # scan emitting a TUPLE of int16 ys miscompiles under XLA CPU jit
+        # in jax 0.9 (wrong values vs disable_jit; int32 tuples and
+        # stacked int16 both compile correctly — verified empirically,
+        # see tests/test_colwalk.py which would catch a recurrence).
+        out = jnp.stack([u_eff, top, cons, qi], axis=-1).astype(jnp.int16)
+        return (i_next, sat), out
+
+    ps = jnp.arange(LA + 2, dtype=jnp.int32)
+    (_, sat), ys = jax.lax.scan(
+        step, (lq, jnp.zeros(lq.shape, bool)), ps, reverse=True)
+    ch = jnp.transpose(ys, (1, 0, 2))
+    return {"ins_len": ch[..., 0], "qstart": ch[..., 1],
+            "op_c": ch[..., 2], "qi_c": ch[..., 3], "sat": sat}

@@ -39,6 +39,7 @@ import jax.numpy as jnp
 
 from racon_tpu.ops.cigar import DIAG, UP, LEFT
 from racon_tpu.ops.flat import PAD_OP  # shared op padding marker
+from racon_tpu.ops.flat import U_SAT as _U_SAT
 from racon_tpu.ops.poa import _EPS as EPS  # shared tie-break epsilon
 
 K_INS = 8          # pileup columns per gap kept on device
@@ -211,6 +212,143 @@ def extract_votes(ops, q, qw, w_read, lt, t_off, LA: int,
     # Run mean weight -> stop-weight by run length (lengths 2..K).
     # Stacked gather #4: weight prefix sum at the run end.
     run_sum = _take1(qwcum, qstart + ins_len) - cum_start
+    wmean = jnp.where(multi, run_sum / jnp.maximum(ins_len, 1), 0.0)
+    lw_oh = (jnp.clip(ins_len, 0, K_INS)[..., None] ==
+             jnp.arange(2, K_INS + 1)[None, None, :])
+    lenw_ch = lw_oh * (wmean * multi)[..., None]      # [B, LA+1, K-1]
+
+    return {
+        "col_w": col_w_ch, "col_c": col_c_ch,
+        "cross_w": cross_w[..., None],
+        "ins1_w": ins1_w_ch, "ins1_c": ins1_c_ch,
+        "ins1_stop": ins1_stop[..., None],
+        "pile_w": pile_w_ch.reshape(B, LA + 1, -1),
+        "pile_c": pile_c_ch.reshape(B, LA + 1, -1),
+        "lenw": lenw_ch,
+    }
+
+
+def extract_votes_cols(cols, q, qw8, w_read, lt, t_off, LA: int):
+    """Per-job anchor-aligned dense vote channels from column-walk output.
+
+    The production replacement for :func:`extract_votes`. The column-walk
+    traceback (racon_tpu/ops/colwalk.py) already emits ``ins_len /
+    qstart / op_c / qi_c`` keyed by anchor position, so no re-keying
+    gathers are needed; the only gather left is ONE merged query-window
+    read. Key fact: the consumer's query index ``qi`` differs from the
+    run start ``qstart`` by at most 1, so a single uint8 window of
+    offsets [-1, U_SAT) around qstart serves the column base/weight
+    (legacy gather #2), the k-shifted pileup channels (legacy gather #3)
+    and the run weight sum (legacy gather #4, now an in-register masked
+    sum — exact, since weights are integers and partial sums stay far
+    below 2^24). Per-call TPU gather dispatch costs ~35-45 ms at bench
+    shapes regardless of width (PROFILE.md), so going from 4 gathers +
+    flip + cumsums to 1 gather is the whole point.
+
+    Every channel value consumed downstream is bit-identical to
+    extract_votes' (masked-out garbage may differ; all returned channels
+    are masked). Insertion runs longer than U_SAT are handled by the
+    walk's saturation redo flag, never by these channels.
+
+    Args:
+      cols: dict from colwalk.col_walk ([B, LA+2] int16 arrays).
+      q: uint8[B, Lq] query codes.
+      qw8: uint8[B, Lq] encoded weights (value + 1, 0 = padding).
+      w_read, lt, t_off, LA: as extract_votes.
+    """
+    B, Lq = q.shape
+    ltc = lt[:, None]
+    pa = jnp.arange(LA + 1, dtype=jnp.int32)[None, :]
+    c = pa - t_off[:, None]                  # anchor-relative position
+    in_cols = (c >= 0) & (c < ltc)
+    in_gaps = (c >= 0) & (c <= ltc)
+
+    ins_len = jnp.where(in_gaps, cols["ins_len"][:, :LA + 1]
+                        .astype(jnp.int32), 0)
+    qstart = cols["qstart"][:, :LA + 1].astype(jnp.int32)
+    # Column p's consumer was emitted by the walk step at p + 1.
+    op_at = cols["op_c"][:, 1:].astype(jnp.int32)
+    qi = cols["qi_c"][:, 1:].astype(jnp.int32)
+    is_match = in_cols & (op_at == DIAG)
+
+    # Merged query-window gather over the FULL LA+2 walk grid: offsets
+    # 0..K around qstart-1 for base codes, 0..U_SAT for weights (run_sum
+    # needs up to U_SAT weights). Gap consumers (pileup/run channels at
+    # anchor p) read row p; the column-p consumer's query index qi was
+    # emitted by walk step p+1 and satisfies qi in {qstart[p+1]-1,
+    # qstart[p+1]}, so its base/weight read row p+1 of the same gather.
+    QO = K_INS + 1
+    WO = _U_SAT + 1
+    qpad = jnp.concatenate(
+        [q, jnp.repeat(q[:, -1:], WO, axis=1)], axis=1)
+    wpad = jnp.concatenate(
+        [qw8, jnp.repeat(qw8[:, -1:], WO, axis=1)], axis=1)
+    stack = jnp.stack([qpad[:, o:o + Lq] for o in range(QO)] +
+                      [wpad[:, o:o + Lq] for o in range(WO)],
+                      axis=-1)                        # [B, Lq, QO+WO] u8
+    qs_full = cols["qstart"].astype(jnp.int32)        # [B, LA+2]
+    qsc_full = jnp.clip(qs_full, 0, Lq - 1)
+    s0_full = jnp.maximum(qsc_full - 1, 0)
+    Gfull = jnp.take_along_axis(stack, s0_full[:, :, None], axis=1)
+    G = Gfull[:, :LA + 1]                             # gap rows (step p)
+    qwin = G[..., :QO].astype(jnp.int32)              # q[s0 + o]
+    wwin = jnp.maximum(G[..., QO:].astype(jnp.float32) - 1.0, 0.0)
+    o1 = (qsc_full - s0_full)[:, :LA + 1] == 1
+
+    def sel_q(o):
+        return jnp.where(o1, qwin[..., o + 1], qwin[..., o])
+
+    def sel_w(o):
+        return jnp.where(o1, wwin[..., o + 1], wwin[..., o])
+
+    Gc = Gfull[:, 1:]                                 # column rows (p+1)
+    qi1 = (jnp.clip(qi, 0, Lq - 1) - s0_full[:, 1:]) == 1
+    colbase = jnp.where(qi1, Gc[..., 1], Gc[..., 0]).astype(jnp.int32)
+    colw = jnp.maximum(
+        jnp.where(qi1, Gc[..., QO + 1], Gc[..., QO])
+        .astype(jnp.float32) - 1.0, 0.0)
+    wq = jnp.where(is_match, colw, w_read[:, None])   # per-column weight
+
+    cols_m = in_cols[:, :LA]
+    base_idx = jnp.where(is_match[:, :LA], colbase[:, :LA], NBASE)
+    col_w = jnp.where(cols_m, jnp.where(is_match[:, :LA], colw[:, :LA],
+                                        w_read[:, None]), 0.0)
+    col_oh = _onehot(base_idx, NBASE + 1)
+    col_w_ch = col_oh * col_w[..., None]                       # [B, LA, 6]
+    col_c_ch = col_oh[..., :NBASE] * (is_match[:, :LA] &
+                                      cols_m)[..., None]       # [B, LA, 5]
+
+    # Direct crossings: columns c-1 and c both consumed, no insertion.
+    crossed = (c >= 1) & (c <= ltc - 1) & (ins_len == 0)
+    wq_prev = jnp.concatenate([w_read[:, None], wq[:, :LA]], axis=1)
+    cross_w = jnp.where(crossed, 0.5 * (wq_prev + wq), 0.0)    # [B, LA+1]
+
+    # Insertions.
+    has1 = in_gaps & (ins_len == 1)
+    multi = in_gaps & (ins_len >= 2)
+    b1 = sel_q(0)
+    w1 = sel_w(0)
+    ins1_oh = _onehot(jnp.where(has1, b1, NBASE), NBASE + 1)[..., :NBASE]
+    ins1_w_ch = ins1_oh * jnp.where(has1, w1, 0.0)[..., None]
+    ins1_c_ch = ins1_oh * has1[..., None]
+    ins1_stop = jnp.where(has1, w1, 0.0)
+
+    # Pileup columns k = 0..K-1 for multi-base runs (no gathers).
+    pk_w, pk_c = [], []
+    for k in range(K_INS):
+        inrun = multi & (ins_len > k)
+        oh = _onehot(jnp.where(inrun, sel_q(k), NBASE),
+                     NBASE + 1)[..., :NBASE]
+        pk_w.append(oh * jnp.where(inrun, sel_w(k), 0.0)[..., None])
+        pk_c.append(oh * inrun[..., None])
+    pile_w_ch = jnp.stack(pk_w, axis=2)               # [B, LA+1, K, 5]
+    pile_c_ch = jnp.stack(pk_c, axis=2)
+
+    # Run mean weight -> stop-weight by run length (lengths 2..K); the
+    # full run weight sum comes from the same window (runs past U_SAT
+    # never reach here — the walk's sat flag reroutes them).
+    run_sum = sum(jnp.where(ins_len > k, sel_w(k), 0.0)
+                  for k in range(_U_SAT))
     wmean = jnp.where(multi, run_sum / jnp.maximum(ins_len, 1), 0.0)
     lw_oh = (jnp.clip(ins_len, 0, K_INS)[..., None] ==
              jnp.arange(2, K_INS + 1)[None, None, :])

@@ -118,9 +118,13 @@ def window_band_delta(w: Window) -> int:
 
 
 def band_width_for(max_delta: int) -> int:
-    """Band slots covering a max length-difference with >=128 slack per
-    side, on the 128 grid."""
-    return _round_up(max_delta + 2 * 128 + 1, 128)
+    """Band slots covering a max length-difference with >=64 slack per
+    side, on the 128 grid. 64 keeps the per-lane escape bound easily
+    satisfiable on real polishing data (wl >= 64 certifies every lambda
+    window) while cutting band cells ~25-33% vs the former 128; lanes
+    whose optimum needs a wider corridor fail the bound and re-polish on
+    the unbounded host path — exactness never rests on the slack."""
+    return _round_up(max_delta + 2 * 64 + 1, 128)
 
 
 def dir_elems(n_jobs: int, max_lq: int, max_bb: int) -> int:
@@ -262,7 +266,7 @@ def _use_pallas(B: int, Lq: int, LA: int) -> bool:
 
 
 def _round_core(bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf, *,
-                match, mismatch, gap, ins_scale, Lq, steps, n_win,
+                match, mismatch, gap, ins_scale, Lq, n_win,
                 LA, pallas, band_w=0, axis_name=None):
     """One alignment + merge round (traced body, single shard's view).
 
@@ -296,6 +300,7 @@ def _round_core(bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf, *,
     lt = jnp.where(full, L, e_c - b_c + 1).astype(jnp.int32)
 
     flat = bb.reshape(-1)
+    from racon_tpu.ops.colwalk import col_walk
     esc_w = None
     if band_w:
         # Diagonal band (racon_tpu/ops/pallas/band_kernel.py): per-lane
@@ -304,21 +309,30 @@ def _round_core(bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf, *,
         # aligner, and failing lanes route their windows to the host
         # redo path via the sticky ovf flag.
         from racon_tpu.ops.pallas.band_kernel import (
-            fw_dirs_band, fw_dirs_band_xla, fw_traceback_band,
-            band_geometry)
+            fw_dirs_band, fw_dirs_band_xla, band_geometry)
         klo, wl = band_geometry(lq, lt, band_w)
-        y = jnp.arange(band_w + Lq, dtype=jnp.int32)[None, :]
+        PW = band_w + Lq
+        y = jnp.arange(PW, dtype=jnp.int32)[None, :]
         rel = klo[:, None] + y                     # slice-relative index
         okb = (rel >= 0) & (rel < lt[:, None])
-        gidxb = (win[:, None] * LA +
-                 jnp.clip(t_off[:, None] + rel, 0, LA - 1))
-        tband = jnp.where(okb, jnp.take(flat, gidxb), 7).astype(jnp.uint8)
+        # Per-lane slices are CONTIGUOUS runs of the anchor table, so a
+        # batched dynamic_slice (slice-mode gather) replaces the element
+        # gather — 26 ms vs 55 ms at bench shapes (PROFILE.md); the
+        # padding margins make every start index in-range, the okb mask
+        # reproduces the clip semantics bit-for-bit.
+        tab = jnp.concatenate(
+            [jnp.zeros((PW,), flat.dtype), flat,
+             jnp.zeros((PW,), flat.dtype)])
+        start = win * LA + t_off + klo + PW
+        sl = jax.vmap(
+            lambda s: jax.lax.dynamic_slice(tab, (s,), (PW,)))(start)
+        tband = jnp.where(okb, sl, 7).astype(jnp.uint8)
         fwd = fw_dirs_band if pallas else fw_dirs_band_xla
         dirs, hlast = fwd(tband, q.T, klo, lq,
                           match=match, mismatch=mismatch, gap=gap,
                           W=band_w)
-        rev = fw_traceback_band(dirs, lq, lt, klo, steps,
-                                transposed=pallas)
+        cols = col_walk(dirs, lq, lt, klo, t_off, LA=LA,
+                        layout="band_t" if pallas else "band")
         # Escape bound (see nw.cpp): banded score must beat any path
         # that leaves the band, else the lane's window is re-polished on
         # the unbounded host path.
@@ -328,11 +342,16 @@ def _round_core(bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf, *,
                  gap * (jnp.abs(lt - lq) + 2 * wl + 2))
         esc_w = ((score < bound) | (wl < 16)).astype(jnp.float32)
     else:
-        # Full-width absolute coordinates: tbuf[b, x] = anchor slice.
+        # Full-width absolute coordinates: tbuf[b, x] = anchor slice
+        # (same batched dynamic_slice trick as the banded path).
         x = jnp.arange(LA, dtype=jnp.int32)[None, :]
         ok = x < lt[:, None]
-        gidx = (win[:, None] * LA + jnp.clip(t_off[:, None] + x, 0, LA - 1))
-        tbuf = jnp.where(ok, jnp.take(flat, gidx), 7).astype(jnp.uint8)
+        tab = jnp.concatenate(
+            [flat, jnp.zeros((LA,), flat.dtype)])
+        start = win * LA + t_off
+        sl = jax.vmap(
+            lambda s: jax.lax.dynamic_slice(tab, (s,), (LA,)))(start)
+        tbuf = jnp.where(ok, sl, 7).astype(jnp.uint8)
         if pallas:
             from racon_tpu.ops.pallas.flat_kernel import fw_dirs_pallas
             dirs = fw_dirs_pallas(tbuf, q.T,
@@ -341,17 +360,16 @@ def _round_core(bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf, *,
             dirs = flatmod.fw_dirs_xla(tbuf, q.T,
                                        match=match, mismatch=mismatch,
                                        gap=gap)
-        rev = flatmod.fw_traceback(dirs, lq, lt, steps)
-    ops = jnp.flip(rev, axis=1)
+        cols = col_walk(dirs, lq, lt, None, t_off, LA=LA, layout="flat")
 
-    qw = jnp.maximum(qw8.astype(jnp.float32) - 1.0, 0.0)
-    votes = dm.extract_votes(ops, q, qw, w_read, lt, t_off, LA,
-                             pallas=pallas)
+    votes = dm.extract_votes_cols(cols, q, qw8, w_read, lt, t_off, LA)
+    # Saturated up-run counters make the walk inexact for that lane —
+    # same redo route as the band escape bound.
+    sat_w = cols["sat"].astype(jnp.float32)
+    esc_w = sat_w if esc_w is None else esc_w + sat_w
     # The band-escape per-window sum rides aggregate_votes' membership
     # matrix and the same single psum as the votes.
-    acc = dm.aggregate_votes(
-        votes, win, n_win + 1,
-        extras={"_esc": esc_w} if esc_w is not None else None)
+    acc = dm.aggregate_votes(votes, win, n_win + 1, extras={"_esc": esc_w})
     if axis_name is not None:
         acc = {k: jax.lax.psum(v, axis_name) for k, v in acc.items()}
     wesc = acc.pop("_esc", None)
@@ -384,17 +402,64 @@ def _round_core(bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf, *,
 
 device_round = functools.partial(
     __import__("jax").jit,
-    static_argnames=("match", "mismatch", "gap", "ins_scale", "Lq", "steps",
+    static_argnames=("match", "mismatch", "gap", "ins_scale", "Lq",
                      "n_win", "LA", "pallas", "band_w"))(_round_core)
 
 
 @functools.partial(
     __import__("jax").jit,
-    static_argnames=("match", "mismatch", "gap", "ins_scale", "Lq", "steps",
+    static_argnames=("match", "mismatch", "gap", "ins_scale", "Lq",
+                     "n_win", "LA", "pallas", "band_w", "rounds", "mesh"))
+def device_rounds_packed(bb, bbw, alen, begin, end, q, qw8, lq, w_read,
+                         win, *, match, mismatch, gap, ins_scale, Lq,
+                         n_win, LA, pallas, band_w, rounds,
+                         mesh=None):
+    """All refinement rounds + output packing in ONE jit dispatch.
+
+    Every synchronized call through the axon tunnel costs ~13 ms of
+    dispatch latency (measured round 5; PROFILE.md), so a chunk that
+    chained 4 round calls + 1 pack call paid ~65 ms of pure overhead —
+    this folds them into a single executable. With ``mesh``, each round
+    is the dp-sharded shard_map of device_round_sharded, sequenced
+    inside the same program (one psum per round, as before).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    ovf = jnp.zeros(n_win, dtype=bool)
+    cov = None
+    if mesh is not None:
+        from jax.sharding import PartitionSpec as P
+        core = functools.partial(
+            _round_core, match=match, mismatch=mismatch, gap=gap,
+            ins_scale=ins_scale, Lq=Lq, n_win=n_win, LA=LA,
+            pallas=pallas, band_w=band_w, axis_name="dp")
+        rep = P()
+        job = P("dp")
+        rnd = jax.shard_map(
+            core, mesh=mesh,
+            in_specs=(rep, rep, rep, job, job, job, job, job, job, job,
+                      rep),
+            out_specs=(rep, rep, rep, job, job, rep, rep),
+            check_vma=False)
+    else:
+        rnd = functools.partial(
+            _round_core, match=match, mismatch=mismatch, gap=gap,
+            ins_scale=ins_scale, Lq=Lq, n_win=n_win, LA=LA,
+            pallas=pallas, band_w=band_w)
+    for _ in range(rounds):
+        bb, bbw, alen, begin, end, cov, ovf = rnd(
+            bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf)
+    return _pack_body(bb[:-1], cov, alen[:-1], ovf)
+
+
+@functools.partial(
+    __import__("jax").jit,
+    static_argnames=("match", "mismatch", "gap", "ins_scale", "Lq",
                      "n_win", "LA", "pallas", "band_w", "mesh"))
 def device_round_sharded(bb, bbw, alen, begin, end, q, qw8, lq, w_read,
                          win, ovf, *, match, mismatch, gap, ins_scale, Lq,
-                         steps, n_win, LA, pallas, band_w, mesh):
+                         n_win, LA, pallas, band_w, mesh):
     """device_round with the job axis sharded over the mesh's "dp" axis.
 
     Window arrays (anchors, lengths, ovf) stay replicated; each chip
@@ -407,7 +472,7 @@ def device_round_sharded(bb, bbw, alen, begin, end, q, qw8, lq, w_read,
 
     core = functools.partial(
         _round_core, match=match, mismatch=mismatch, gap=gap,
-        ins_scale=ins_scale, Lq=Lq, steps=steps, n_win=n_win, LA=LA,
+        ins_scale=ins_scale, Lq=Lq, n_win=n_win, LA=LA,
         pallas=pallas, band_w=band_w, axis_name="dp")
     rep = P()
     job = P("dp")
@@ -422,11 +487,10 @@ def device_round_sharded(bb, bbw, alen, begin, end, q, qw8, lq, w_read,
     return fn(bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf)
 
 
-@functools.partial(__import__("jax").jit)
-def _pack_out(codes, cov, alen, ovf):
+def _pack_body(codes, cov, alen, ovf):
     """Flatten codes/cov/lengths/overflow into one uint8 buffer for a
-    single d2h transfer (each synchronized pull pays ~75 ms tunnel
-    latency)."""
+    single d2h transfer (each synchronized pull pays ~13 ms tunnel
+    latency). The byte layout is the contract collect_chunk unpacks."""
     import jax
     import jax.numpy as jnp
     c16 = jnp.clip(cov, 0, 32767).astype(jnp.int16)
@@ -437,6 +501,9 @@ def _pack_out(codes, cov, alen, ovf):
         jax.lax.bitcast_convert_type(tail, jnp.uint8).reshape(-1),
         ovf.astype(jnp.uint8),
     ])
+
+
+_pack_out = functools.partial(__import__("jax").jit)(_pack_body)
 
 
 def dispatch_chunk(plan: ChunkPlan, *, match: int, mismatch: int,
@@ -495,13 +562,28 @@ def dispatch_chunk(plan: ChunkPlan, *, match: int, mismatch: int,
     bb, bbw, alen, begin, end, q, qw8, lq, w_read, win = dev_args
     if collect:
         t0 = sync(alen, "h2d", t0)
+    if not verbose:
+        # Production path: the whole chunk (all rounds + packing) is ONE
+        # dispatch — each synchronized tunnel call costs ~13 ms. Stats
+        # collection syncs once on the packed result ("compute" phase).
+        packed = device_rounds_packed(
+            bb, bbw, alen, begin, end, q, qw8, lq, w_read, win,
+            match=match, mismatch=mismatch, gap=gap, ins_scale=ins_scale,
+            Lq=plan.Lq, n_win=plan.n_win, LA=plan.LA,
+            pallas=pallas, band_w=band_w, rounds=rounds, mesh=mesh)
+        if collect:
+            t0 = sync(packed, "compute", t0)
+        if stats is not None:
+            stats["chunks"] = stats.get("chunks", 0) + 1
+            stats["_t_pack"] = time.perf_counter()
+        return packed
     cov = None
     ovf = jnp.zeros(plan.n_win, dtype=bool)
     for r in range(rounds):
         bb, bbw, alen, begin, end, cov, ovf = rnd(
             bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf,
             match=match, mismatch=mismatch, gap=gap, ins_scale=ins_scale,
-            Lq=plan.Lq, steps=plan.steps, n_win=plan.n_win,
+            Lq=plan.Lq, n_win=plan.n_win,
             LA=plan.LA, pallas=pallas, band_w=band_w)
         if verbose:
             t0 = sync(cov, f"compute/round{r}", t0)

@@ -27,6 +27,7 @@ from racon_tpu.ops.cigar import DIAG, UP, LEFT
 
 PAD_OP = 3
 _NEG = -(2 ** 30)
+U_SAT = 15  # UP-run saturation in the packed cell byte (4 bits)
 
 
 @functools.partial(jax.jit, static_argnames=("match", "mismatch", "gap"))
@@ -44,8 +45,11 @@ def fw_dirs_xla(tbuf: jnp.ndarray, qT: jnp.ndarray, *, match: int,
     # H[0][j] = j*gap. Derived from t32 (not a fresh constant) so the
     # scan carry is device-varying under shard_map.
     P0 = jg + jnp.zeros_like(t32[:, :1])
+    U0 = jnp.zeros((B, Lt), jnp.int32)
+    C0 = jnp.full((B, Lt), LEFT, jnp.int32)
 
-    def step(P, inp):
+    def step(carry, inp):
+        P, Up, Cp = carry
         i, qrow = inp
         sub = jnp.where(t32 == qrow[:, None], match, mismatch)
         Pshift = jnp.concatenate(
@@ -59,11 +63,17 @@ def fw_dirs_xla(tbuf: jnp.ndarray, qT: jnp.ndarray, *, match: int,
             jr == 0, 0, _NEG)) - jg, axis=1)
         h = f + jg
         d = jnp.where(h == diag, DIAG,
-                      jnp.where(h == up, UP, LEFT)).astype(jnp.uint8)
-        return h, d
+                      jnp.where(h == up, UP, LEFT))
+        # UP-chain metadata for the column-walk traceback (colwalk.py):
+        # absolute coordinates, so the UP predecessor is the same lane.
+        isup = d == UP
+        U = jnp.where(isup, jnp.minimum(Up + 1, U_SAT), 0)
+        C = jnp.where(isup, Cp, d)
+        packed = (d + (C << 2) + (U << 4)).astype(jnp.uint8)
+        return (h, U, C), packed
 
     ii = jnp.arange(1, qT.shape[0] + 1, dtype=jnp.int32)
-    _, dirs = jax.lax.scan(step, P0, (ii, qT.astype(jnp.int32)))
+    _, dirs = jax.lax.scan(step, (P0, U0, C0), (ii, qT.astype(jnp.int32)))
     return dirs
 
 
@@ -79,7 +89,7 @@ def fw_traceback(dirs: jnp.ndarray, lq: jnp.ndarray, lt: jnp.ndarray,
         done = (i == 0) & (j == 0)
         idx = (jnp.maximum(i - 1, 0) * (B * Lt) + lane * Lt
                + jnp.maximum(j - 1, 0))
-        dv = jnp.take(d1, idx)
+        dv = jnp.take(d1, idx) & 3        # low bits of the packed cell
         d = jnp.where(done, PAD_OP,
                       jnp.where(i == 0, LEFT,
                                 jnp.where(j == 0, UP, dv))).astype(jnp.uint8)

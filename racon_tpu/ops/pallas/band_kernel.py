@@ -50,17 +50,43 @@ from jax.experimental.pallas import tpu as pltpu
 from racon_tpu.ops.cigar import DIAG, UP, LEFT
 
 _NEG = -(2 ** 30)
+_NEG16 = -16384  # int16 kernel's -inf (see _score_dtype for the proof)
 TB = 128   # jobs per grid program (sublanes)
 CH = 32    # query rows per grid step
+U_SAT = 15  # UP-run saturation in the packed cell byte (4 bits)
+
+
+def _score_dtype(match: int, mismatch: int, gap: int, Lq: int, W: int):
+    """int16 when every DP intermediate provably fits, else int32.
+
+    The int16 scheme uses NEG16 = -16384 with one extra clamp (see
+    _kernel): masked-cell chains stay <= NEG16 + gap at any real cell, so
+    results are bit-identical to the int32 kernel as long as
+      |jcol| * |gap| <= 16383   (jg magnitude; |jcol| <= Lq + W)
+      match * Lq + (Lq + W) * |gap| <= 32767  (f = tmp - jg upper bound)
+    hold — halving VPU register traffic for the whole forward pass.
+    """
+    a = max(abs(match), abs(mismatch), abs(gap))
+    jgmax = (Lq + W) * abs(gap)
+    if jgmax <= 16383 and max(match, 0) * Lq + a * Lq + jgmax <= 32767:
+        # DISABLED: Mosaic on this stack cannot legalize vector int16
+        # max ('failed to legalize operation arith.maxsi'), and the DP
+        # row is max-heavy (11 of ~19 vector ops), so a compare+select
+        # emulation would give back most of the halved register traffic.
+        # Shape analysis kept for a future stack where i16 max lowers.
+        return jnp.int32
+    return jnp.int32
 
 
 def _kernel(tbandT_ref, qT_ref, klo_ref, lq_ref, dirs_ref, hlast_ref,
-            prev_ref, *, match, mismatch, gap, W):
+            prev_ref, uprev_ref, cprev_ref, *, match, mismatch, gap, W,
+            dtype):
     # Transposed layout: band slots x on SUBLANES, jobs on LANES. The
     # per-row moving target window is then a dynamic *sublane* slice
     # (supported by Mosaic at any offset), where the lane-major variant
     # would need a 128-aligned dynamic lane slice (rejected).
     c = pl.program_id(1)
+    NEG = _NEG16 if dtype == jnp.int16 else _NEG   # Python int: inlines
     xr = jax.lax.broadcasted_iota(jnp.int32, (W, TB), 0)
     klo = klo_ref[0]                       # [TB] int32
     lqv = lq_ref[0]                        # [TB] int32
@@ -70,8 +96,14 @@ def _kernel(tbandT_ref, qT_ref, klo_ref, lq_ref, dirs_ref, hlast_ref,
         # prev[x] = H[0][klo + x] = (klo+x)*gap where klo+x >= 0 (the
         # j = 0 column holds 0 = H[0][0]); cells left of j=0 are -inf.
         j0 = klo[None, :] + xr
-        prev_ref[:] = jnp.where(j0 >= 0, j0 * gap, _NEG)
-        hlast_ref[:] = jnp.where(j0 >= 0, j0 * gap, _NEG)
+        init = jnp.where(j0 >= 0, j0 * gap, NEG).astype(dtype)
+        prev_ref[:] = init
+        hlast_ref[:] = init
+        # UP-chain metadata boundary (row 0): no UP can start above row 1,
+        # and a chain that reaches row 0 is consumed by the forced LEFT
+        # walk along the top row — encode that as consumer dir LEFT.
+        uprev_ref[:] = jnp.zeros((W, TB), jnp.int32)
+        cprev_ref[:] = jnp.full((W, TB), LEFT, jnp.int32)
 
     def row(r, _):
         i = c * CH + r + 1                 # 1-based global row
@@ -79,29 +111,55 @@ def _kernel(tbandT_ref, qT_ref, klo_ref, lq_ref, dirs_ref, hlast_ref,
         tw = tbandT_ref[pl.dslice(i - 1, W), :]           # [W, TB] int32
         jcol = i + klo[None, :] + xr       # absolute target column j
         sub = jnp.where(tw == qrow[None, :], match, mismatch)
-        sub = jnp.where(jcol >= 1, sub, _NEG)  # no diag into j < 1
+        sub = jnp.where(jcol >= 1, sub, NEG).astype(dtype)
         P = prev_ref[:]
-        diag = P + sub
+        diag = P + sub                     # >= 2*NEG, exactly int16-min
         up = jnp.concatenate(
-            [P[1:, :], jnp.full((1, TB), _NEG, jnp.int32)], axis=0) + gap
+            [P[1:, :], jnp.full((1, TB), NEG, dtype)], axis=0) + \
+            jnp.asarray(gap, dtype)
         tmp = jnp.maximum(diag, up)
         # j == 0 boundary column: H[i][0] = i*gap, entering at x0 = -i-klo.
-        tmp = jnp.where(jcol == 0, i * gap, tmp)
+        tmp = jnp.where(jcol == 0, i * gap, tmp).astype(dtype)
+        # Clamp before the jg subtraction: masked cells carry 2*NEG and
+        # would wrap int16 under "- jg" for negative jcol. Real cells are
+        # far above NEG, and clamped masked chains still lose at every
+        # real cell by >= |gap| (see _score_dtype).
+        tmp = jnp.maximum(tmp, jnp.asarray(NEG, dtype))
         # Left-gap chain: shift-max ladder along sublanes (j grows with x).
-        jg = jcol * gap
+        jg = (jcol * gap).astype(dtype)
         f = tmp - jg
         s = 1
         while s < W:
             f = jnp.maximum(
                 f, jnp.concatenate(
-                    [jnp.full((s, TB), _NEG // 2, jnp.int32), f[:-s, :]],
+                    [jnp.full((s, TB), NEG, dtype), f[:-s, :]],
                     axis=0))
             s *= 2
         h = f + jg
-        h = jnp.where(jcol >= 0, h, _NEG)
-        d = jnp.where(h == diag, DIAG,
-                      jnp.where(h == up, UP, LEFT)).astype(jnp.uint8)
-        dirs_ref[r] = d
+        h = jnp.where(jcol >= 0, h, NEG).astype(dtype)
+        # The direction select stays in the score dtype end to end: a
+        # mask from an int16 compare selecting int32 scalars needs an i1
+        # relayout Mosaic rejects ("Invalid relayout ... vector<...xi1>"),
+        # while same-width select + one plain convert lowers cleanly.
+        d = jnp.where(h == diag, jnp.asarray(DIAG, dtype),
+                      jnp.where(h == up, jnp.asarray(UP, dtype),
+                                jnp.asarray(LEFT, dtype))).astype(jnp.int32)
+        # UP-chain metadata for the column-walk traceback (colwalk.py):
+        # cell (i, j)'s UP predecessor is (i-1, j) = band slot x+1 of the
+        # previous row, so chains run along the +1 sublane shift. U counts
+        # the chain length into this cell (saturating at U_SAT; saturated
+        # lanes are re-polished on the host path), C carries the chain
+        # top's consumer direction down the chain.
+        isup = d == UP
+        uup = jnp.concatenate(
+            [uprev_ref[1:, :], jnp.zeros((1, TB), jnp.int32)], axis=0)
+        cup = jnp.concatenate(
+            [cprev_ref[1:, :], jnp.full((1, TB), LEFT, jnp.int32)], axis=0)
+        U = jnp.where(isup, jnp.minimum(uup + 1, U_SAT), 0)
+        C = jnp.where(isup, cup, d)
+        dirs_ref[r] = (d + (C << 2) + (U << 4)).astype(jnp.uint8)
+        uprev_ref[:] = U
+        cprev_ref[:] = C
         prev_ref[:] = h
         # Capture each lane's true final row as the row counter passes it.
         hlast_ref[:] = jnp.where((lqv == i)[None, :], h, hlast_ref[:])
@@ -115,7 +173,7 @@ def _kernel(tbandT_ref, qT_ref, klo_ref, lq_ref, dirs_ref, hlast_ref,
 def fw_dirs_band(tband: jnp.ndarray, qT: jnp.ndarray, klo: jnp.ndarray,
                  lq: jnp.ndarray, *, match: int, mismatch: int, gap: int,
                  W: int):
-    """Banded direction tensor + final-row scores (Pallas, transposed).
+    """Banded packed-cell tensor + final-row scores (Pallas, transposed).
 
     Args:
       tband: int32[B, W + Lq] pre-shifted targets (see module docstring).
@@ -123,15 +181,19 @@ def fw_dirs_band(tband: jnp.ndarray, qT: jnp.ndarray, klo: jnp.ndarray,
       klo:   int32[B] per-lane band origin.
       lq:    int32[B] per-lane query lengths (for final-row capture).
 
-    Returns (dirs uint8[Lq, W, B], hlast int32[B, W]) — note dirs has
+    Returns (cells uint8[Lq, W, B], hlast int32[B, W]) — note cells has
     band slots *before* jobs (kernel layout); fw_traceback_band takes
     ``transposed=True`` for it. hlast[b, x] = H[lq_b][lq_b + klo_b + x].
-    B % 128 == 0, Lq % 32 == 0, W % 128 == 0 required.
+    Each cell byte packs ``dir | consumer_dir << 2 | up_run << 4`` (see
+    racon_tpu/ops/colwalk.py for the traceback that consumes it; the
+    plain direction is the low 2 bits). B % 128 == 0, Lq % 32 == 0,
+    W % 128 == 0 required.
     """
     B = tband.shape[0]
     Lq = qT.shape[0]
+    dtype = _score_dtype(match, mismatch, gap, Lq, W)
     kernel = functools.partial(_kernel, match=match, mismatch=mismatch,
-                               gap=gap, W=W)
+                               gap=gap, W=W, dtype=dtype)
     dirs, hlast = pl.pallas_call(
         kernel,
         grid=(B // TB, Lq // CH),
@@ -153,14 +215,16 @@ def fw_dirs_band(tband: jnp.ndarray, qT: jnp.ndarray, klo: jnp.ndarray,
         ],
         out_shape=[
             jax.ShapeDtypeStruct((Lq, W, B), jnp.uint8),
-            jax.ShapeDtypeStruct((W, B), jnp.int32),
+            jax.ShapeDtypeStruct((W, B), dtype),
         ],
-        scratch_shapes=[pltpu.VMEM((W, TB), jnp.int32)],
+        scratch_shapes=[pltpu.VMEM((W, TB), dtype),
+                        pltpu.VMEM((W, TB), jnp.int32),
+                        pltpu.VMEM((W, TB), jnp.int32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")),
     )(tband.astype(jnp.int32).T, qT.astype(jnp.int32),
       klo[None, :], lq[None, :])
-    return dirs, hlast.T
+    return dirs, hlast.T.astype(jnp.int32)
 
 
 @functools.partial(jax.jit,
@@ -169,47 +233,63 @@ def fw_dirs_band_xla(tband: jnp.ndarray, qT: jnp.ndarray, klo: jnp.ndarray,
                      lq: jnp.ndarray, *, match: int, mismatch: int,
                      gap: int, W: int):
     """Row-scan twin of fw_dirs_band (CPU tests / non-TPU fallback);
-    bit-identical outputs by construction."""
+    bit-identical outputs by construction (same score dtype selection,
+    fills and clamps as the Pallas kernel)."""
     B = tband.shape[0]
     Lq = qT.shape[0]
+    dtype = _score_dtype(match, mismatch, gap, Lq, W)
+    NEG = _NEG16 if dtype == jnp.int16 else _NEG
     xr = jnp.arange(W, dtype=jnp.int32)[None, :]
     t32 = tband.astype(jnp.int32)
     j0 = klo[:, None] + xr
-    P0 = jnp.where(j0 >= 0, j0 * gap, _NEG) + jnp.zeros_like(t32[:, :1])
+    P0 = (jnp.where(j0 >= 0, j0 * gap, NEG) +
+          jnp.zeros_like(t32[:, :1])).astype(dtype)
     hl0 = P0
+    U0 = jnp.zeros((B, W), jnp.int32)
+    C0 = jnp.full((B, W), LEFT, jnp.int32)
 
     def step(carry, inp):
-        P, hl = carry
+        P, hl, Up, Cp = carry
         i, qrow = inp
         tw = jax.lax.dynamic_slice_in_dim(t32, i - 1, W, axis=1)
         jcol = i + klo[:, None] + xr
         sub = jnp.where(tw == qrow[:, None], match, mismatch)
-        sub = jnp.where(jcol >= 1, sub, _NEG)
+        sub = jnp.where(jcol >= 1, sub, NEG).astype(dtype)
         diag = P + sub
         up = jnp.concatenate(
-            [P[:, 1:], jnp.full((B, 1), _NEG, jnp.int32)], axis=1) + gap
+            [P[:, 1:], jnp.full((B, 1), NEG, dtype)], axis=1) + \
+            jnp.asarray(gap, dtype)
         tmp = jnp.maximum(diag, up)
-        tmp = jnp.where(jcol == 0, i * gap, tmp)
-        jg = jcol * gap
+        tmp = jnp.where(jcol == 0, i * gap, tmp).astype(dtype)
+        tmp = jnp.maximum(tmp, jnp.asarray(NEG, dtype))
+        jg = (jcol * gap).astype(dtype)
         f = tmp - jg
         s = 1
         while s < W:
             f = jnp.maximum(
                 f, jnp.concatenate(
-                    [jnp.full((B, s), _NEG // 2, jnp.int32), f[:, :-s]],
+                    [jnp.full((B, s), NEG, dtype), f[:, :-s]],
                     axis=1))
             s *= 2
         h = f + jg
-        h = jnp.where(jcol >= 0, h, _NEG)
+        h = jnp.where(jcol >= 0, h, NEG).astype(dtype)
         d = jnp.where(h == diag, DIAG,
-                      jnp.where(h == up, UP, LEFT)).astype(jnp.uint8)
+                      jnp.where(h == up, UP, LEFT))
+        isup = d == UP
+        uup = jnp.concatenate(
+            [Up[:, 1:], jnp.zeros((B, 1), jnp.int32)], axis=1)
+        cup = jnp.concatenate(
+            [Cp[:, 1:], jnp.full((B, 1), LEFT, jnp.int32)], axis=1)
+        U = jnp.where(isup, jnp.minimum(uup + 1, U_SAT), 0)
+        C = jnp.where(isup, cup, d)
+        packed = (d + (C << 2) + (U << 4)).astype(jnp.uint8)
         hl = jnp.where((lq == i)[:, None], h, hl)
-        return (h, hl), d
+        return (h, hl, U, C), packed
 
     ii = jnp.arange(1, Lq + 1, dtype=jnp.int32)
-    (_, hlast), dirs = jax.lax.scan(step, (P0, hl0),
-                                    (ii, qT.astype(jnp.int32)))
-    return dirs, hlast
+    (_, hlast, _, _), dirs = jax.lax.scan(step, (P0, hl0, U0, C0),
+                                          (ii, qT.astype(jnp.int32)))
+    return dirs, hlast.astype(jnp.int32)
 
 
 def band_geometry(lq, lt, W: int):
@@ -223,11 +303,13 @@ def band_geometry(lq, lt, W: int):
 def fw_traceback_band(dirs: jnp.ndarray, lq: jnp.ndarray, lt: jnp.ndarray,
                       klo: jnp.ndarray, steps: int,
                       transposed: bool = False):
-    """Traceback over banded dirs: rev ops uint8[B, steps].
+    """Traceback over banded packed cells: rev ops uint8[B, steps].
 
     Identical walk to flat.fw_traceback with the column index mapped to
     band coordinates x = j - i - klo per lane. ``transposed`` selects
-    the Pallas kernel's [Lq, W, B] dirs layout (vs [Lq, B, W]).
+    the Pallas kernel's [Lq, W, B] dirs layout (vs [Lq, B, W]). Legacy
+    op-by-op walk kept for tests and the sp path; the production
+    traceback is the column-walk (racon_tpu/ops/colwalk.py).
     """
     if transposed:
         Lq, W, B = dirs.shape
@@ -244,7 +326,7 @@ def fw_traceback_band(dirs: jnp.ndarray, lq: jnp.ndarray, lt: jnp.ndarray,
             idx = (jnp.maximum(i - 1, 0) * (B * W) + x * B + lane)
         else:
             idx = (jnp.maximum(i - 1, 0) * (B * W) + lane * W + x)
-        dv = jnp.take(d1, idx)
+        dv = jnp.take(d1, idx) & 3        # low bits of the packed cell
         d = jnp.where(done, 3,
                       jnp.where(i == 0, LEFT,
                                 jnp.where(j == 0, UP, dv))).astype(jnp.uint8)

@@ -23,10 +23,11 @@ from racon_tpu.ops.cigar import DIAG, UP, LEFT
 _NEG = -(2 ** 30)
 TB = 128   # jobs per grid program
 CH = 32    # query rows per grid step
+U_SAT = 15  # UP-run saturation in the packed cell byte (4 bits)
 
 
-def _kernel(tbuf_ref, qT_ref, dirs_ref, prev_ref, *, match, mismatch, gap,
-            Lt):
+def _kernel(tbuf_ref, qT_ref, dirs_ref, prev_ref, uprev_ref, cprev_ref, *,
+            match, mismatch, gap, Lt):
     c = pl.program_id(1)
     jr = jax.lax.broadcasted_iota(jnp.int32, (TB, Lt), 1)
     jg = (jr + 1) * gap
@@ -35,6 +36,8 @@ def _kernel(tbuf_ref, qT_ref, dirs_ref, prev_ref, *, match, mismatch, gap,
     @pl.when(c == 0)
     def _():
         prev_ref[:] = jg                   # H[0][j] = j*gap
+        uprev_ref[:] = jnp.zeros((TB, Lt), jnp.int32)
+        cprev_ref[:] = jnp.full((TB, Lt), LEFT, jnp.int32)
 
     shifts = []
     k = 1
@@ -61,8 +64,15 @@ def _kernel(tbuf_ref, qT_ref, dirs_ref, prev_ref, *, match, mismatch, gap,
                     [jnp.full((TB, s), _NEG, jnp.int32), f[:, :-s]], axis=1))
         h = f + jg
         d = jnp.where(h == diag, DIAG,
-                      jnp.where(h == up, UP, LEFT)).astype(jnp.uint8)
-        dirs_ref[r] = d
+                      jnp.where(h == up, UP, LEFT))
+        # UP-chain metadata (colwalk.py): in absolute coordinates the UP
+        # predecessor (i-1, j) is the SAME lane of the previous row.
+        isup = d == UP
+        U = jnp.where(isup, jnp.minimum(uprev_ref[:] + 1, U_SAT), 0)
+        C = jnp.where(isup, cprev_ref[:], d)
+        dirs_ref[r] = (d + (C << 2) + (U << 4)).astype(jnp.uint8)
+        uprev_ref[:] = U
+        cprev_ref[:] = C
         prev_ref[:] = h
         return 0
 
@@ -92,7 +102,9 @@ def fw_dirs_pallas(tbuf: jnp.ndarray, qT: jnp.ndarray, *, match: int,
         out_specs=pl.BlockSpec((CH, TB, Lt), lambda b, c: (c, b, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((Lq, B, Lt), jnp.uint8),
-        scratch_shapes=[pltpu.VMEM((TB, Lt), jnp.int32)],
+        scratch_shapes=[pltpu.VMEM((TB, Lt), jnp.int32),
+                        pltpu.VMEM((TB, Lt), jnp.int32),
+                        pltpu.VMEM((TB, Lt), jnp.int32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")),
     )(tbuf.astype(jnp.int32), qT.astype(jnp.int32))

@@ -1,0 +1,174 @@
+"""Ablation: tband build strategies and g3/g2 gather dtype slimming."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timeit(fn, *args, reps=4):
+    np.asarray(jax.tree.leaves(fn(*args))[0]).ravel()[:1]
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    np.asarray(jax.tree.leaves(out)[0]).ravel()[:1]
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    B, Lq, W, LA = 3072, 640, 384, 768
+    n_win = 96
+    rng = np.random.default_rng(0)
+    flat = jnp.asarray(rng.integers(0, 4, (n_win + 1) * LA).astype(np.uint8))
+    win = jnp.asarray(np.repeat(np.arange(n_win + 1), 32)[:B].astype(np.int32))
+    t_off = jnp.zeros(B, jnp.int32)
+    klo = jnp.full(B, -192, jnp.int32)
+    lt = jnp.full(B, 500, jnp.int32)
+
+    @jax.jit
+    def tband_take():
+        y = jnp.arange(W + Lq, dtype=jnp.int32)[None, :]
+        rel = klo[:, None] + y
+        okb = (rel >= 0) & (rel < lt[:, None])
+        gidxb = (win[:, None] * LA + jnp.clip(t_off[:, None] + rel, 0,
+                                              LA - 1))
+        return jnp.sum(jnp.where(okb, jnp.take(flat, gidxb), 7)
+                       .astype(jnp.uint8)[:, 0], dtype=jnp.int32)
+
+    print(f"tband take       : {timeit(tband_take) * 1e3:7.1f} ms",
+          flush=True)
+
+    # Slice-mode: pad the anchor table so per-lane slices never clip,
+    # then one vmapped dynamic_slice (lowers to a slice-gather).
+    PADW = W + Lq
+
+    @jax.jit
+    def tband_slice():
+        tab = jnp.concatenate(
+            [jnp.full((PADW,), 7, flat.dtype), flat,
+             jnp.full((PADW,), 7, flat.dtype)])
+        start = win * LA + t_off + klo + PADW
+        y = jnp.arange(PADW, dtype=jnp.int32)[None, :]
+        rel = klo[:, None] + y
+        okb = (rel >= 0) & (rel < lt[:, None])
+        sl = jax.vmap(lambda s: jax.lax.dynamic_slice(tab, (s,), (PADW,)))(
+            start)
+        # clip semantics beyond the anchor row differ from take; mask ok
+        out = jnp.where(okb, sl, 7)
+        return jnp.sum(out[:, 0], dtype=jnp.int32)
+
+    print(f"tband dyn-slice  : {timeit(tband_slice) * 1e3:7.1f} ms",
+          flush=True)
+
+    # g3-style gathers at qstart indices
+    qstart = jnp.asarray(rng.integers(0, Lq - 8, (B, LA + 1)).astype(np.int32))
+    qx = jnp.asarray(rng.integers(0, 4, (B, Lq)).astype(np.uint8))
+    qw8 = jnp.asarray(rng.integers(1, 40, (B, Lq)).astype(np.uint8))
+    K = 8
+
+    @jax.jit
+    def g3_f32(qstart):
+        qw = qw8.astype(jnp.float32)
+        qwcum = jnp.concatenate(
+            [jnp.zeros((B, 1), jnp.float32), jnp.cumsum(qw, axis=1)], axis=1)
+        qx_pad = jnp.concatenate(
+            [qx.astype(jnp.int32),
+             jnp.repeat(qx[:, -1:].astype(jnp.int32), K - 1, axis=1)], axis=1)
+        qw_pad = jnp.concatenate(
+            [qw, jnp.repeat(qw[:, -1:], K - 1, axis=1)], axis=1)
+        chans = ([qx_pad[:, k:k + Lq].astype(jnp.float32)
+                  for k in range(K)] +
+                 [qw_pad[:, k:k + Lq] for k in range(K)] +
+                 [qwcum[:, :Lq]])
+        stack = jnp.stack(chans, axis=-1)
+        G = jnp.take_along_axis(stack, qstart[:, :, None], axis=1)
+        return jnp.sum(G[:, 0])
+
+    print(f"g3 f32 17ch      : {timeit(g3_f32, qstart) * 1e3:7.1f} ms",
+          flush=True)
+
+    @jax.jit
+    def g3_u8(qstart):
+        # 16 uint8 channels in one gather + qwcum int32 in another
+        qx_pad = jnp.concatenate(
+            [qx, jnp.repeat(qx[:, -1:], K - 1, axis=1)], axis=1)
+        qw_pad = jnp.concatenate(
+            [qw8, jnp.repeat(qw8[:, -1:], K - 1, axis=1)], axis=1)
+        chans = ([qx_pad[:, k:k + Lq] for k in range(K)] +
+                 [qw_pad[:, k:k + Lq] for k in range(K)])
+        stack = jnp.stack(chans, axis=-1)                 # [B, Lq, 16] u8
+        G = jnp.take_along_axis(stack, qstart[:, :, None], axis=1)
+        qwcum = jnp.concatenate(
+            [jnp.zeros((B, 1), jnp.int32),
+             jnp.cumsum(qw8.astype(jnp.int32), axis=1)], axis=1)
+        Gc = jnp.take_along_axis(qwcum, qstart, axis=1)
+        return jnp.sum(G[:, 0].astype(jnp.int32)) + jnp.sum(Gc[:, 0])
+
+    print(f"g3 u8 16ch+cum   : {timeit(g3_u8, qstart) * 1e3:7.1f} ms",
+          flush=True)
+
+    @jax.jit
+    def g3_u8_interleave(qstart):
+        # single uint8 stack including 4 bytes of qwcum bitcast
+        qw = qw8.astype(jnp.int32)
+        qwcum = jnp.concatenate(
+            [jnp.zeros((B, 1), jnp.int32), jnp.cumsum(qw, axis=1)],
+            axis=1)[:, :Lq]
+        cum8 = jax.lax.bitcast_convert_type(qwcum, jnp.uint8)  # [B, Lq, 4]
+        qx_pad = jnp.concatenate(
+            [qx, jnp.repeat(qx[:, -1:], K - 1, axis=1)], axis=1)
+        qw_pad = jnp.concatenate(
+            [qw8, jnp.repeat(qw8[:, -1:], K - 1, axis=1)], axis=1)
+        chans = ([qx_pad[:, k:k + Lq] for k in range(K)] +
+                 [qw_pad[:, k:k + Lq] for k in range(K)])
+        stack = jnp.concatenate(
+            [jnp.stack(chans, axis=-1), cum8], axis=-1)   # [B, Lq, 20] u8
+        G = jnp.take_along_axis(stack, qstart[:, :, None], axis=1)
+        return jnp.sum(G[:, 0].astype(jnp.int32))
+
+    print(f"g3 u8 20ch 1gthr : {timeit(g3_u8_interleave, qstart) * 1e3:7.1f}"
+          f" ms", flush=True)
+
+    # g2-style: 2 channels at qi
+    qi = jnp.asarray(rng.integers(0, Lq, (B, LA + 1)).astype(np.int32))
+
+    @jax.jit
+    def g2_f32(qi):
+        stack = jnp.stack([qx.astype(jnp.float32),
+                           qw8.astype(jnp.float32)], axis=-1)
+        G = jnp.take_along_axis(stack, qi[:, :, None], axis=1)
+        return jnp.sum(G[:, 0])
+
+    print(f"g2 f32 2ch       : {timeit(g2_f32, qi) * 1e3:7.1f} ms",
+          flush=True)
+
+    @jax.jit
+    def g2_u8(qi):
+        stack = jnp.stack([qx, qw8], axis=-1)
+        G = jnp.take_along_axis(stack, qi[:, :, None], axis=1)
+        return jnp.sum(G[:, 0].astype(jnp.int32))
+
+    print(f"g2 u8 2ch        : {timeit(g2_u8, qi) * 1e3:7.1f} ms",
+          flush=True)
+
+    # rekey gathers (int16, 2ch) as in extract_votes_cols
+    S = LA + 1
+    ch16 = jnp.asarray(rng.integers(0, 600, (B, S, 2)).astype(np.int16))
+    tg = jnp.asarray(rng.integers(0, S, (B, LA + 1)).astype(np.int32))
+
+    @jax.jit
+    def rekey(tg):
+        G = jnp.take_along_axis(ch16, tg[:, :, None], axis=1)
+        return jnp.sum(G[:, 0].astype(jnp.int32))
+
+    print(f"rekey i16 2ch    : {timeit(rekey, tg) * 1e3:7.1f} ms",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -59,7 +59,7 @@ def main():
     print(f"h2d: {time.perf_counter() - t0:.3f}s", flush=True)
 
     pallas = _use_pallas(plan.B, plan.Lq, plan.LA)
-    LA, Lq, steps, n_win = plan.LA, plan.Lq, plan.steps, plan.n_win
+    LA, Lq, n_win = plan.LA, plan.Lq, plan.n_win
 
     @functools.partial(jax.jit, static_argnames=("upto",))
     def stage(bb, bbw, alen, begin, end, q, qw8, lqv, w_read, win, *,
@@ -72,11 +72,11 @@ def main():
         t_off = jnp.where(full, 0, b_c).astype(jnp.int32)
         lt = jnp.where(full, L, e_c - b_c + 1).astype(jnp.int32)
         flat = bb.reshape(-1)
+        from racon_tpu.ops.colwalk import col_walk
         band_w = plan.band_w
         if band_w:
             from racon_tpu.ops.pallas.band_kernel import (
-                fw_dirs_band, fw_dirs_band_xla, fw_traceback_band,
-                band_geometry)
+                fw_dirs_band, fw_dirs_band_xla, band_geometry)
             klo, wl = band_geometry(lqv, lt, band_w)
             y = jnp.arange(band_w + Lq, dtype=jnp.int32)[None, :]
             rel = klo[:, None] + y
@@ -85,13 +85,16 @@ def main():
                      jnp.clip(t_off[:, None] + rel, 0, LA - 1))
             tband = jnp.where(okb, jnp.take(flat, gidxb),
                               7).astype(jnp.uint8)
+            if upto == "tband":
+                return jnp.sum(tband[:, 0], dtype=jnp.int32)
             fwd = fw_dirs_band if pallas else fw_dirs_band_xla
             dirs, hlast = fwd(tband, q.T, klo, lqv, match=M, mismatch=X,
                               gap=G, W=band_w)
             if upto == "fw":
-                return jnp.sum(dirs, dtype=jnp.int32) + jnp.sum(hlast)
-            rev = fw_traceback_band(dirs, lqv, lt, klo, steps,
-                                    transposed=pallas)
+                return (jnp.sum(dirs[0, 0].astype(jnp.int32)) +
+                        jnp.sum(hlast))
+            cols = col_walk(dirs, lqv, lt, klo, t_off, LA=LA,
+                            layout="band_t" if pallas else "band")
         else:
             x = jnp.arange(LA, dtype=jnp.int32)[None, :]
             ok = x < lt[:, None]
@@ -106,14 +109,13 @@ def main():
                 dirs = flatmod.fw_dirs_xla(tbuf, q.T, match=M, mismatch=X,
                                            gap=G)
             if upto == "fw":
-                return jnp.sum(dirs, dtype=jnp.int32)
-            rev = flatmod.fw_traceback(dirs, lqv, lt, steps)
-        ops = jnp.flip(rev, axis=1)
+                return jnp.sum(dirs[0, 0].astype(jnp.int32))
+            cols = col_walk(dirs, lqv, lt, None, t_off, LA=LA,
+                            layout="flat")
         if upto == "tb":
-            return jnp.sum(ops, dtype=jnp.int32)
-        qw = jnp.maximum(qw8.astype(jnp.float32) - 1.0, 0.0)
-        votes = dm.extract_votes(ops, q, qw, w_read, lt, t_off, LA,
-                                 pallas=pallas)
+            return sum(jnp.sum(cols[k][:, 0], dtype=jnp.int32)
+                       for k in ("ins_len", "qstart", "op_c", "qi_c"))
+        votes = dm.extract_votes_cols(cols, q, qw8, w_read, lt, t_off, LA)
         if upto == "votes":
             return sum(jnp.sum(v) for v in votes.values())
         acc = dm.aggregate_votes(votes, win, n_win + 1)
@@ -129,7 +131,7 @@ def main():
 
     args = (bb, bbw, alen, begin, end, q, qw8, lqv, w_read, win)
     prev = 0.0
-    for upto in ("fw", "tb", "votes", "agg", "all"):
+    for upto in ("tband", "fw", "tb", "votes", "agg", "all"):
         dt = t(stage, *args, upto=upto)
         print(f"{upto:6s}: {dt:.3f}s (+{dt - prev:.3f}s)", flush=True)
         prev = dt

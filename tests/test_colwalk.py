@@ -1,0 +1,172 @@
+"""Column-walk traceback (ops/colwalk.py): bit-identity of its vote
+channels against the legacy op-string pipeline, and the saturation redo
+route for pathological insertion runs.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from racon_tpu.ops import device_merge as dm
+from racon_tpu.ops.colwalk import col_walk
+from racon_tpu.ops.flat import fw_dirs_xla, fw_traceback, U_SAT
+from racon_tpu.ops.pallas.band_kernel import (band_geometry,
+                                              fw_dirs_band_xla,
+                                              fw_traceback_band)
+
+M, X, G = 5, -4, -8
+
+
+def _random_jobs(rng, B, err=0.15):
+    qs, ts = [], []
+    for _ in range(B):
+        t = rng.integers(0, 4, int(rng.integers(30, 120))).astype(np.uint8)
+        r = rng.random(len(t))
+        q = []
+        for k, b in enumerate(t):
+            if r[k] < err / 3:
+                continue
+            q.append(rng.integers(0, 4) if r[k] < 2 * err / 3 else b)
+            if r[k] > 1 - err / 3:
+                q.append(rng.integers(0, 4))
+        qs.append(np.asarray(q or [0], np.uint8))
+        ts.append(t)
+    return qs, ts
+
+
+def _pad(qs, ts):
+    B = len(qs)
+    Lq = max(len(q) for q in qs)
+    Lt = max(len(t) for t in ts)
+    tbuf = np.full((B, Lt), 7, np.uint8)
+    qT = np.zeros((Lq, B), np.uint8)
+    lq = np.zeros(B, np.int32)
+    lt = np.zeros(B, np.int32)
+    for b, (q, t) in enumerate(zip(qs, ts)):
+        tbuf[b, :len(t)] = t
+        qT[:len(q), b] = q
+        lq[b], lt[b] = len(q), len(t)
+    return tbuf, qT, lq, lt
+
+
+def _votes_equal(va, vb):
+    for k in va:
+        assert np.array_equal(np.asarray(va[k]), np.asarray(vb[k])), k
+
+
+def test_colwalk_matches_legacy_flat():
+    """extract_votes_cols(col_walk(...)) == extract_votes(legacy ops) —
+    bitwise, full-width layout (every returned channel is masked, so
+    equality is exact, not approximate)."""
+    rng = np.random.default_rng(11)
+    qs, ts = _random_jobs(rng, 17)
+    tbuf, qT, lq, lt = _pad(qs, ts)
+    B, Lt = tbuf.shape
+    Lq = qT.shape[0]
+    LA = Lt
+    t_off = np.zeros(B, np.int32)
+    w_read = rng.uniform(1, 20, B).astype(np.float32)
+    qw = rng.integers(0, 40, (B, Lq)).astype(np.float32)
+
+    dirs = fw_dirs_xla(jnp.asarray(tbuf), jnp.asarray(qT),
+                       match=M, mismatch=X, gap=G)
+    rev = fw_traceback(dirs, jnp.asarray(lq), jnp.asarray(lt), Lq + Lt)
+    ops = jnp.flip(rev, axis=1)
+    old = dm.extract_votes(ops, jnp.asarray(np.ascontiguousarray(qT.T)), jnp.asarray(qw),
+                           jnp.asarray(w_read), jnp.asarray(lt),
+                           jnp.asarray(t_off), LA)
+    cols = col_walk(dirs, jnp.asarray(lq), jnp.asarray(lt), None,
+                    jnp.asarray(t_off), LA=LA, layout="flat")
+    assert not np.asarray(cols["sat"]).any()
+    qw8 = (qw + 1).astype(np.uint8)
+    new = dm.extract_votes_cols(cols, jnp.asarray(np.ascontiguousarray(qT.T)),
+                                jnp.asarray(qw8), jnp.asarray(w_read),
+                                jnp.asarray(lt), jnp.asarray(t_off), LA)
+    _votes_equal(old, new)
+
+
+def test_colwalk_matches_legacy_band():
+    """Same bit-identity through the banded layout with per-lane band
+    origins and nonzero slice offsets."""
+    rng = np.random.default_rng(12)
+    qs, ts = _random_jobs(rng, 9)
+    tbuf, qT, lq, lt = _pad(qs, ts)
+    B = tbuf.shape[0]
+    Lq = qT.shape[0]
+    W = 128
+    LA = tbuf.shape[1] + 16
+    t_off = rng.integers(0, 9, B).astype(np.int32)
+    w_read = rng.uniform(1, 20, B).astype(np.float32)
+    qw = rng.integers(0, 40, (B, Lq)).astype(np.float32)
+
+    klo, _ = band_geometry(jnp.asarray(lq), jnp.asarray(lt), W)
+    klo_h = np.asarray(klo)
+    tband = np.full((B, W + Lq), 7, np.uint8)
+    for b in range(B):
+        for y in range(W + Lq):
+            j = klo_h[b] + y
+            if 0 <= j < lt[b]:
+                tband[b, y] = ts[b][j]
+    dirs, _ = fw_dirs_band_xla(jnp.asarray(tband), jnp.asarray(qT), klo,
+                               jnp.asarray(lq), match=M, mismatch=X,
+                               gap=G, W=W)
+    rev = fw_traceback_band(dirs, jnp.asarray(lq), jnp.asarray(lt), klo,
+                            Lq + W)
+    ops = jnp.flip(rev, axis=1)
+    q = np.zeros((B, Lq), np.uint8)
+    for b, qq in enumerate(qs):
+        q[b, :len(qq)] = qq
+    old = dm.extract_votes(ops, jnp.asarray(q), jnp.asarray(qw),
+                           jnp.asarray(w_read), jnp.asarray(lt),
+                           jnp.asarray(t_off), LA)
+    cols = col_walk(dirs, jnp.asarray(lq), jnp.asarray(lt), klo,
+                    jnp.asarray(t_off), LA=LA, layout="band")
+    assert not np.asarray(cols["sat"]).any()
+    qw8 = (qw + 1).astype(np.uint8)
+    new = dm.extract_votes_cols(cols, jnp.asarray(q), jnp.asarray(qw8),
+                                jnp.asarray(w_read), jnp.asarray(lt),
+                                jnp.asarray(t_off), LA)
+    _votes_equal(old, new)
+
+
+def test_colwalk_leading_insertion_saturation():
+    """A leading insertion run (gap 0, the j==0 closed-form step) longer
+    than U_SAT must also raise the sat flag: extract_votes_cols' window
+    channels only span U_SAT weights, so without the flag the run's
+    length-weight votes would silently truncate."""
+    t = np.tile(np.arange(4, dtype=np.uint8), 15)           # 60 bp target
+    run = np.full(U_SAT + 5, 2, np.uint8)
+    q = np.concatenate([run, t])                            # leading ins
+    tbuf = t[None, :].repeat(2, 0)
+    qT = np.zeros((len(q), 2), np.uint8)
+    qT[:, 0] = q
+    qT[: len(t), 1] = t
+    lq = np.array([len(q), len(t)], np.int32)
+    lt = np.array([len(t), len(t)], np.int32)
+    dirs = fw_dirs_xla(jnp.asarray(tbuf), jnp.asarray(qT),
+                       match=M, mismatch=X, gap=G)
+    cols = col_walk(dirs, jnp.asarray(lq), jnp.asarray(lt), None,
+                    jnp.zeros(2, jnp.int32), LA=len(t), layout="flat")
+    sat = np.asarray(cols["sat"])
+    assert sat[0] and not sat[1]
+
+
+def test_colwalk_saturation_flags():
+    """A forced insertion run longer than U_SAT sets the sticky sat flag
+    (the engine then re-polishes that window on the host path)."""
+    t = np.tile(np.arange(4, dtype=np.uint8), 20)          # 80 bp target
+    run = np.full(U_SAT + 5, 2, np.uint8)                  # 20-base ins
+    q = np.concatenate([t[:40], run, t[40:]])
+    tbuf = t[None, :].repeat(2, 0)
+    qT = np.zeros((len(q), 2), np.uint8)
+    qT[:, 0] = q
+    qT[: len(t), 1] = t
+    lq = np.array([len(q), len(t)], np.int32)
+    lt = np.array([len(t), len(t)], np.int32)
+    dirs = fw_dirs_xla(jnp.asarray(tbuf), jnp.asarray(qT),
+                       match=M, mismatch=X, gap=G)
+    cols = col_walk(dirs, jnp.asarray(lq), jnp.asarray(lt), None,
+                    jnp.zeros(2, jnp.int32), LA=len(t), layout="flat")
+    sat = np.asarray(cols["sat"])
+    assert sat[0] and not sat[1]
