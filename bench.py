@@ -1,27 +1,31 @@
 """Benchmark: POA window consensus throughput (windows/sec/chip).
 
-Prints exactly one JSON line on stdout. Primary value = compute-only
-windows/s (device execution time for all refinement rounds, excluding
-h2d/d2h transfers); end-to-end and phase breakdowns ride along as extra
-keys. Rationale: this environment reaches its TPU through a ~30 MB/s,
-~75 ms-latency tunnel (PROFILE.md), which caps end-to-end throughput at
-a few hundred windows/s regardless of kernel quality; production-attached
-TPUs pay none of that. Both numbers are reported so the tunnel tax stays
-visible.
+Prints exactly one JSON line on stdout. Primary value = end-to-end
+chunk-pipelined windows/s on this chip; the serialized compute-only rate
+and phase breakdown ride along as extra keys. This environment reaches
+its TPU through a slow tunnel (~30 MB/s, ~13 ms round-trip dispatch
+latency, round-5 measurement — PROFILE.md)
+that production-attached TPUs do not pay, so both numbers are reported
+and the tunnel tax stays visible.
 
 Workload matches BASELINE.md's north-star metric: w=500-class windows at
 30x coverage (the reference's hot loop, src/polisher.cpp:451-513 ->
 src/window.cpp:61-137), run through the full PoaEngine device pipeline —
-batched NW forward + traceback + device merge, all refinement rounds on
-chip.
+batched NW forward + column-walk traceback + device merge, all
+refinement rounds on chip.
 
-Baseline: BASELINE.json targets >=20x a 64-thread CPU SPOA path. The
-reference publishes no absolute numbers, so the CPU anchor is estimated
-from the reference's own workload: single-thread racon polishes the
-bundled 96-window lambda dataset in tens of seconds (~2.5 windows/s);
-64 ideal threads ~= 160 windows/s. vs_baseline = compute_value / 160, so
-vs_baseline >= 1.0 means at least estimated-64-thread-CPU parity and
->= 20 hits the north-star target.
+Baseline: BASELINE.json targets >=20x a 64-thread CPU SPOA path on a
+v5e-8 (8 chips). The denominator is MEASURED, not estimated: the repo's
+own native host path (C++ adaptive-band NW + numpy merge — the fastest
+CPU racon-equivalent runnable in this image; the reference binary cannot
+build here, its vendored spoa/edlib trees are absent) does 15.45
+windows/s single-threaded on this exact workload
+(scripts/measure_cpu_anchor.py, 2026-07-30), idealized to 64 threads as
+64 x 15.45 = 988.8 — generous to the CPU, whose merge phase does not
+actually parallelize. vs_baseline = value / 988.8; the north star (20x
+on 8 chips) means vs_baseline >= 2.5 per chip. The reference's own spoa
+path is ~6x slower than our native anchor (~2.5 w/s single-thread
+estimated), so value / 160 rides along as vs_ref_spoa_64t_est.
 """
 
 import json
@@ -30,7 +34,12 @@ import time
 
 import numpy as np
 
-CPU_64T_WINDOWS_PER_SEC = 160.0  # estimated 64-thread CPU SPOA anchor
+# Measured single-thread native-path anchor (scripts/measure_cpu_anchor.py
+# on this image, 2026-07-30: 15.45 w/s at n=64), idealized x64 threads.
+CPU_1T_MEASURED = 15.45
+CPU_64T_WINDOWS_PER_SEC = 64 * CPU_1T_MEASURED          # = 988.8
+CPU_64T_REF_SPOA_EST = 160.0   # reference racon (spoa) estimate, kept
+                               # for cross-round comparability
 
 
 def build_windows(n_windows: int, coverage: int, wlen: int, seed: int = 0):
@@ -119,12 +128,16 @@ def main():
     print(json.dumps({
         "metric": f"POA windows/sec/chip end-to-end, chunk-pipelined "
                   f"(w={wlen}, {coverage}x cov, all refinement rounds on "
-                  f"device, backend={backend}:{dev}; serialized "
-                  "compute-only split in extra keys)",
+                  f"device, backend={backend}:{dev}; vs_baseline = value / "
+                  "MEASURED 64-thread-idealized native CPU anchor "
+                  f"{CPU_64T_WINDOWS_PER_SEC:.1f} "
+                  "w/s; serialized compute-only split in extra keys)",
         "value": round(e2e, 2),
         "unit": "windows/s",
         "vs_baseline": round(e2e / CPU_64T_WINDOWS_PER_SEC, 3),
         "compute_only_windows_per_sec": round(compute, 2),
+        "cpu_anchor_1t_measured": CPU_1T_MEASURED,
+        "vs_ref_spoa_64t_est": round(e2e / CPU_64T_REF_SPOA_EST, 3),
         "n_windows": n_windows,
         "phase_seconds": {k: round(v, 3) for k, v in stats.items()
                           if isinstance(v, float)},

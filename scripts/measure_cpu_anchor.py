@@ -1,0 +1,51 @@
+"""Measure the CPU anchor for bench.py's vs_baseline denominator.
+
+Runs the repo's own native host consensus path (C++ adaptive-band NW via
+ctypes + numpy column merge — the fastest CPU racon-equivalent available
+in this image; the reference binary cannot be built here because its
+vendored spoa/edlib trees are absent from the snapshot) single-threaded
+on the exact bench workload, then reports an idealized 64-thread
+extrapolation (perfect linear scaling — generous to the CPU, since the
+reference's own window fan-out is embarrassingly parallel but its merge
+is not).
+
+Usage: python scripts/measure_cpu_anchor.py [n_windows]
+Prints one JSON line: {"cpu_1t_windows_per_sec": ..., "cpu_64t_idealized":
+..., "n_windows": ..., "host": ...}
+"""
+
+import json
+import os
+import platform
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 64
+    from bench import build_windows
+    from racon_tpu.ops.poa import PoaEngine
+
+    eng = PoaEngine(backend="native", threads=1)
+    eng.consensus_windows(build_windows(8, 30, 500, seed=7))  # warm
+
+    ws = build_windows(n, 30, 500, seed=1)
+    eng = PoaEngine(backend="native", threads=1)
+    t0 = time.perf_counter()
+    eng.consensus_windows(ws)
+    dt = time.perf_counter() - t0
+    r1 = n / dt
+    print(json.dumps({
+        "cpu_1t_windows_per_sec": round(r1, 2),
+        "cpu_64t_idealized": round(64 * r1, 1),
+        "n_windows": n,
+        "seconds": round(dt, 2),
+        "host": platform.processor() or platform.machine(),
+        "n_cores_here": os.cpu_count(),
+    }))
+
+
+if __name__ == "__main__":
+    main()
