@@ -253,6 +253,34 @@ class ChunkPlan:
                     best = W
             self.band_w = best
 
+    def packed_bufs(self):
+        """(job_buf u8[B, 2*Lq+20], win_buf u8[Nw+1, 5*LA+4]) — every
+        chunk input concatenated into two byte buffers so each chunk is
+        TWO h2d transfers instead of ten. The tunnel's per-transfer
+        latency dominated h2d at bench scale (~2.1 s for ~12 MB split
+        over 10 arrays x 2 chunks; PROFILE.md round 5). Layout must match
+        device_chunk_packed's unpack slicing exactly; the job buffer is
+        dp-shardable along axis 0, the window buffer replicates."""
+        B, Lq, LA = self.B, self.Lq, self.LA
+        job = np.empty((B, 2 * Lq + 20), np.uint8)
+        job[:, :Lq] = self.q
+        job[:, Lq:2 * Lq] = self.qw8
+        sc = job[:, 2 * Lq:]
+        sc[:, 0:4] = self.begin.astype(np.int32).view(np.uint8).reshape(B, 4)
+        sc[:, 4:8] = self.end.astype(np.int32).view(np.uint8).reshape(B, 4)
+        sc[:, 8:12] = self.lq.astype(np.int32).view(np.uint8).reshape(B, 4)
+        sc[:, 12:16] = self.win.astype(np.int32).view(np.uint8).reshape(B, 4)
+        sc[:, 16:20] = self.w_read.astype(np.float32).view(np.uint8) \
+            .reshape(B, 4)
+        Nw1 = self.n_win + 1
+        winb = np.empty((Nw1, 5 * LA + 4), np.uint8)
+        winb[:, :LA] = self.bb
+        winb[:, LA:5 * LA] = self.bbw.astype(np.float32).view(np.uint8) \
+            .reshape(Nw1, 4 * LA)
+        winb[:, 5 * LA:] = self.alen.astype(np.int32).view(np.uint8) \
+            .reshape(Nw1, 4)
+        return job, winb
+
 
 def _use_pallas(B: int, Lq: int, LA: int) -> bool:
     import os
@@ -410,21 +438,41 @@ device_round = functools.partial(
     __import__("jax").jit,
     static_argnames=("match", "mismatch", "gap", "ins_scale", "Lq",
                      "n_win", "LA", "pallas", "band_w", "rounds", "mesh"))
-def device_rounds_packed(bb, bbw, alen, begin, end, q, qw8, lq, w_read,
-                         win, *, match, mismatch, gap, ins_scale, Lq,
-                         n_win, LA, pallas, band_w, rounds,
-                         mesh=None):
-    """All refinement rounds + output packing in ONE jit dispatch.
+def device_chunk_packed(job_buf, win_buf, *, match, mismatch, gap,
+                        ins_scale, Lq, n_win, LA, pallas, band_w, rounds,
+                        mesh=None):
+    """One chunk end to end in ONE jit dispatch from TWO byte buffers.
 
-    Every synchronized call through the axon tunnel costs ~13 ms of
-    dispatch latency (measured round 5; PROFILE.md), so a chunk that
-    chained 4 round calls + 1 pack call paid ~65 ms of pure overhead —
-    this folds them into a single executable. With ``mesh``, each round
-    is the dp-sharded shard_map of device_round_sharded, sequenced
-    inside the same program (one psum per round, as before).
+    Inputs arrive as ChunkPlan.packed_bufs()' concatenated layouts (two
+    h2d transfers instead of ten — per-transfer tunnel latency dominated
+    h2d at bench scale) and every refinement round plus the output
+    packing runs inside a single executable (each synchronized dispatch
+    costs ~13 ms; PROFILE.md round 5). With ``mesh``, each round is the
+    dp-sharded shard_map of device_round_sharded sequenced inside the
+    same program (one psum per round, as before); the job buffer is
+    sharded along jobs, the window buffer replicated.
     """
     import jax
     import jax.numpy as jnp
+
+    def i32(col):
+        return jax.lax.bitcast_convert_type(col, jnp.int32)
+
+    q = job_buf[:, :Lq]
+    qw8 = job_buf[:, Lq:2 * Lq]
+    sc = job_buf[:, 2 * Lq:]
+    B = job_buf.shape[0]
+    begin = i32(sc[:, 0:4].reshape(B, 1, 4))[:, 0]
+    end = i32(sc[:, 4:8].reshape(B, 1, 4))[:, 0]
+    lq = i32(sc[:, 8:12].reshape(B, 1, 4))[:, 0]
+    win = i32(sc[:, 12:16].reshape(B, 1, 4))[:, 0]
+    w_read = jax.lax.bitcast_convert_type(
+        sc[:, 16:20].reshape(B, 1, 4), jnp.float32)[:, 0]
+    Nw1 = win_buf.shape[0]
+    bb = win_buf[:, :LA]
+    bbw = jax.lax.bitcast_convert_type(
+        win_buf[:, LA:5 * LA].reshape(Nw1, LA, 4), jnp.float32)
+    alen = i32(win_buf[:, 5 * LA:].reshape(Nw1, 1, 4))[:, 0]
 
     ovf = jnp.zeros(n_win, dtype=bool)
     cov = None
@@ -546,6 +594,39 @@ def dispatch_chunk(plan: ChunkPlan, *, match: int, mismatch: int,
     band_w = (0 if os.environ.get("RACON_TPU_NO_BAND", "")
               not in ("", "0", "false") else plan.band_w)
     t0 = time.perf_counter()
+    if not verbose:
+        # Production path: TWO h2d byte buffers, then the whole chunk
+        # (all rounds + output packing) as ONE dispatch — per-transfer
+        # and per-dispatch tunnel latency otherwise dominate. Stats
+        # collection syncs once on each phase edge.
+        job_h, win_h = plan.packed_bufs()
+        if mesh is None:
+            job_buf, win_buf = jax.device_put((job_h, win_h))
+        else:
+            from jax.sharding import NamedSharding, PartitionSpec
+            job_buf = jax.device_put(
+                job_h, NamedSharding(mesh, PartitionSpec("dp")))
+            win_buf = jax.device_put(
+                win_h, NamedSharding(mesh, PartitionSpec()))
+        if collect:
+            # Sync on BOTH buffers: device_put is async, and an
+            # in-flight job_buf would otherwise bleed into "compute".
+            t0 = sync(job_buf, "h2d/job", t0)
+            t0 = sync(win_buf, "h2d", t0)
+        packed = device_chunk_packed(
+            job_buf, win_buf,
+            match=match, mismatch=mismatch, gap=gap, ins_scale=ins_scale,
+            Lq=plan.Lq, n_win=plan.n_win, LA=plan.LA,
+            pallas=pallas, band_w=band_w, rounds=rounds, mesh=mesh)
+        if collect:
+            t0 = sync(packed, "compute", t0)
+        if stats is not None:
+            stats["chunks"] = stats.get("chunks", 0) + 1
+            stats["_t_pack"] = time.perf_counter()
+        return packed
+
+    # Verbose path: separate arrays + one dispatch per round so each
+    # round's wall time stays attributable (RACON_TPU_TIMING=1).
     host_args = (plan.bb, plan.bbw, plan.alen, plan.begin, plan.end,
                  plan.q, plan.qw8, plan.lq, plan.w_read, plan.win)
     if mesh is None:
@@ -560,23 +641,7 @@ def dispatch_chunk(plan: ChunkPlan, *, match: int, mismatch: int,
         dev_args = tuple(jax.device_put(a, s)
                          for a, s in zip(host_args, shardings))
     bb, bbw, alen, begin, end, q, qw8, lq, w_read, win = dev_args
-    if collect:
-        t0 = sync(alen, "h2d", t0)
-    if not verbose:
-        # Production path: the whole chunk (all rounds + packing) is ONE
-        # dispatch — each synchronized tunnel call costs ~13 ms. Stats
-        # collection syncs once on the packed result ("compute" phase).
-        packed = device_rounds_packed(
-            bb, bbw, alen, begin, end, q, qw8, lq, w_read, win,
-            match=match, mismatch=mismatch, gap=gap, ins_scale=ins_scale,
-            Lq=plan.Lq, n_win=plan.n_win, LA=plan.LA,
-            pallas=pallas, band_w=band_w, rounds=rounds, mesh=mesh)
-        if collect:
-            t0 = sync(packed, "compute", t0)
-        if stats is not None:
-            stats["chunks"] = stats.get("chunks", 0) + 1
-            stats["_t_pack"] = time.perf_counter()
-        return packed
+    t0 = sync(alen, "h2d", t0)
     cov = None
     ovf = jnp.zeros(plan.n_win, dtype=bool)
     for r in range(rounds):
@@ -585,10 +650,7 @@ def dispatch_chunk(plan: ChunkPlan, *, match: int, mismatch: int,
             match=match, mismatch=mismatch, gap=gap, ins_scale=ins_scale,
             Lq=plan.Lq, n_win=plan.n_win,
             LA=plan.LA, pallas=pallas, band_w=band_w)
-        if verbose:
-            t0 = sync(cov, f"compute/round{r}", t0)
-    if collect and not verbose:
-        t0 = sync(cov, "compute", t0)
+        t0 = sync(cov, f"compute/round{r}", t0)
     if stats is not None:
         stats["chunks"] = stats.get("chunks", 0) + 1
         stats["_t_pack"] = time.perf_counter()
