@@ -1,0 +1,165 @@
+"""Genome-scale end-to-end acceptance/perf run (BASELINE.json config 2).
+
+Synthesizes a truth genome, a ~2%-error draft contig, 30x ~8 kb reads at
+~10% error (half reverse-strand) with qualities, and a PAF overlap file
+with draft-coordinate mappings; then runs the FULL CLI pipeline (parse
+-> initialize -> polish) as a subprocess and reports wall time per
+phase, windows/s, peak RSS, and sampled identity of the polished contig
+vs the truth.
+
+Usage:
+  python scripts/genome_bench.py [genome_mb] [coverage] [--backend auto]
+Prints one JSON line. Work dir: /tmp/racon_tpu_genome (reused).
+"""
+
+import json
+import os
+import resource
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+BASES = np.frombuffer(b"ACGT", np.uint8)
+
+
+def mutate(rng, seq, rate):
+    """Vectorized mutation (sub/ins/del each rate/3); returns (mutated,
+    map) where map[i] = position of truth base i in the output (deleted
+    bases map to the previous surviving position)."""
+    n = len(seq)
+    r = rng.random(n)
+    dele = r < rate / 3
+    sub = (r >= rate / 3) & (r < 2 * rate / 3)
+    ins = (r >= 2 * rate / 3) & (r < rate)
+    counts = np.where(dele, 0, np.where(ins, 2, 1))
+    starts = np.cumsum(counts) - counts
+    out = np.zeros(int(counts.sum()), np.uint8)
+    keep = ~dele
+    base = np.where(sub, BASES[rng.integers(0, 4, n)], seq)
+    out[starts[keep]] = base[keep]
+    out[starts[ins] + 1] = BASES[rng.integers(0, 4, int(ins.sum()))]
+    posmap = np.maximum.accumulate(np.where(keep, starts, -1))
+    posmap = np.maximum(posmap, 0).astype(np.int64)
+    return out, posmap
+
+
+RC = np.zeros(256, np.uint8)
+RC[np.frombuffer(b"ACGT", np.uint8)] = np.frombuffer(b"TGCA", np.uint8)
+
+
+def main():
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    genome_mb = float(args[0]) if args else 5.0
+    coverage = int(args[1]) if len(args) > 1 else 30
+    n = int(genome_mb * 1e6)
+    read_len = 8000
+    rng = np.random.default_rng(7)
+
+    d = "/tmp/racon_tpu_genome"
+    os.makedirs(d, exist_ok=True)
+    t0 = time.perf_counter()
+
+    truth = BASES[rng.integers(0, 4, n)]
+    draft, posmap = mutate(rng, truth, 0.02)
+    with open(f"{d}/draft.fasta", "w") as f:
+        f.write(">contig1\n")
+        f.write(draft.tobytes().decode())
+        f.write("\n")
+
+    n_reads = n * coverage // read_len
+    paf = []
+    with open(f"{d}/reads.fastq", "wb") as f:
+        for i in range(n_reads):
+            p = int(rng.integers(0, n - read_len))
+            seg, _ = mutate(rng, truth[p:p + read_len], 0.10)
+            strand = rng.random() < 0.5
+            if strand:
+                seg = RC[seg][::-1]
+            q = rng.integers(33 + 8, 33 + 40, len(seg),
+                             dtype=np.uint8).tobytes()
+            name = f"r{i}"
+            f.write(b"@" + name.encode() + b"\n" + seg.tobytes() + b"\n+\n"
+                    + q + b"\n")
+            ts, te = int(posmap[p]), int(posmap[p + read_len - 1]) + 1
+            paf.append(f"{name}\t{len(seg)}\t0\t{len(seg)}\t"
+                       f"{'-' if strand else '+'}\tcontig1\t{len(draft)}\t"
+                       f"{ts}\t{te}\t{read_len}\t{read_len}\t60")
+    with open(f"{d}/overlaps.paf", "w") as f:
+        f.write("\n".join(paf) + "\n")
+    t_gen = time.perf_counter() - t0
+
+    backend = "auto"
+    for a in sys.argv[1:]:
+        if a.startswith("--backend="):
+            backend = a.split("=", 1)[1]
+    t0 = time.perf_counter()
+    proc = subprocess.run(
+        [sys.executable, "-m", "racon_tpu.cli", "--backend", backend,
+         f"{d}/reads.fastq", f"{d}/overlaps.paf", f"{d}/draft.fasta"],
+        capture_output=True, cwd=os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+    t_polish = time.perf_counter() - t0
+    if proc.returncode != 0:
+        sys.stderr.write(proc.stderr.decode()[-3000:])
+        sys.exit(1)
+    out = proc.stdout.decode()
+    polished = out.split("\n", 1)[1].replace("\n", "").encode()
+    phases = [ln for ln in proc.stderr.decode().splitlines() if "[racon" in ln]
+    peak_rss_mb = resource.getrusage(resource.RUSAGE_CHILDREN).ru_maxrss / 1024
+
+    # Sampled identity: align 20 x 10 kb chunks of the polished contig
+    # against the corresponding truth slices (+-2 kb slop).
+    from racon_tpu.native.aligner import NativeAligner
+    from racon_tpu.ops.encode import encode_bases
+    al = NativeAligner(0, -1, -1)
+
+    def sampled_identity_vs_truth(contig: bytes, n_samples: int = 20):
+        scale = len(contig) / n
+        eds, tot = 0, 0
+        for s in np.linspace(0, len(contig) - 10000,
+                             n_samples).astype(int):
+            pc = contig[s:s + 10000]
+            ts = max(0, int(s / scale) - 2000)
+            tc = truth[ts:ts + 14000].tobytes()
+            ops = np.asarray(al.align(pc, tc))
+            qa, ta = encode_bases(pc), encode_bases(tc)
+            qi = ti = ed = 0
+            for dd in ops:
+                if dd == 0:
+                    ed += int(qa[qi] != ta[ti]); qi += 1; ti += 1
+                elif dd == 1:
+                    ed += 1; qi += 1
+                else:
+                    ed += 1; ti += 1
+            # The truth slice deliberately overhangs the chunk by 2 kb
+            # per side; a global alignment must delete the overhang, and
+            # tie-breaking scatters those deletions, so subtract the
+            # unavoidable length difference instead of trimming flanks.
+            eds += max(ed - (len(tc) - len(pc)), 0)
+            tot += len(pc)
+        return 1 - eds / max(tot, 1)
+
+    identity = sampled_identity_vs_truth(polished)
+    draft_identity = sampled_identity_vs_truth(draft.tobytes(), 8)
+
+    n_windows = -(-len(draft) // 500)
+    print(json.dumps({
+        "genome_mb": genome_mb, "coverage": coverage,
+        "n_reads": n_reads, "n_windows": n_windows,
+        "gen_seconds": round(t_gen, 1),
+        "polish_seconds": round(t_polish, 1),
+        "windows_per_sec_e2e": round(n_windows / t_polish, 2),
+        "peak_rss_mb": round(peak_rss_mb, 1),
+        "sampled_identity": round(identity, 6),
+        "draft_identity_vs_truth": round(draft_identity, 6),
+        "polished_len": len(polished),
+        "phases": phases[-8:],
+    }))
+
+
+if __name__ == "__main__":
+    main()
