@@ -1,15 +1,32 @@
 #!/usr/bin/env bash
 # CI entry point (reference parity: .travis.yml:32-37 runs racon_test on
-# every build). Runs the full CPU suite, the multi-chip dryrun, and the
-# two-shape device-engine smoke — the regression class that shipped in
-# round 3 (two differently-shaped consensus runs in one process crashed
-# with INVALID_ARGUMENT; reproducible on the CPU backend, see
+# every build). Default tier runs the full CPU suite, the flagship
+# device-engine golden (ED vs the reference acceptance value — a gate,
+# not a docstring), the multi-chip dryrun, and the two-shape
+# device-engine smoke — the regression class that shipped in round 3
+# (two differently-shaped consensus runs in one process crashed with
+# INVALID_ARGUMENT; reproducible on the CPU backend, see
 # scripts/tpu_two_shape_repro.py).
+#
+#   ci.sh          default tier
+#   ci.sh --full   additionally runs every opt-in 'ava' golden
+#                  (fragment-correction acceptance set)
 set -euo pipefail
 cd "$(dirname "$0")"
 
-echo "[ci] pytest (CPU, 8 virtual devices)"
-python -m pytest tests/ -q
+FULL=0
+[[ "${1:-}" == "--full" ]] && FULL=1
+
+if [[ "$FULL" == 1 ]]; then
+  echo "[ci] pytest (CPU, 8 virtual devices, FULL incl. ava goldens)"
+  python -m pytest tests/ -q -m ''
+else
+  echo "[ci] pytest (CPU, 8 virtual devices)"
+  python -m pytest tests/ -q
+  echo "[ci] device-engine golden (SAM+FASTQ acceptance, gates ED <= 1317)"
+  python -m pytest tests/test_polisher.py -q -m '' \
+    -k test_consensus_device_engine_golden_sam_fastq
+fi
 
 echo "[ci] multi-chip dryrun (8 virtual devices)"
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

@@ -63,6 +63,15 @@ def build_parser() -> argparse.ArgumentParser:
                     help="default: auto; alignment backend — 'jax' targets "
                          "the TPU/accelerator, 'native' the C++ host "
                          "aligner, 'auto' picks by available hardware")
+    ap.add_argument("--dp", type=int, default=0, metavar="N",
+                    help="default: 0 (single device); shard consensus "
+                         "chunks over a data-parallel mesh of N devices "
+                         "(see docs/DISTRIBUTED.md)")
+    ap.add_argument("--distributed", action="store_true",
+                    help="multi-host: call jax.distributed.initialize() "
+                         "(coordinator/process env auto-detected on TPU "
+                         "pods) before building the device mesh; combine "
+                         "with --dp <total devices>")
     ap.add_argument("--version", action="store_true",
                     help="prints the version number")
     ap.add_argument("-h", "--help", action="store_true",
@@ -91,13 +100,44 @@ def main(argv: Optional[List[str]] = None) -> int:
     from racon_tpu.utils.logger import Logger
 
     logger = Logger()
+    mesh = None
+    if args.distributed or args.dp:
+        import numpy as _np
+        import jax
+        if args.dp < 0:
+            print(f"[racon_tpu::] error: invalid --dp {args.dp}!",
+                  file=sys.stderr)
+            return 1
+        if args.distributed:
+            # Multi-host: every host runs this same command; coordinator
+            # address / process count / process id come from the TPU pod
+            # runtime environment (docs/DISTRIBUTED.md has the recipe).
+            jax.distributed.initialize()
+        devs = jax.devices()
+        ndp = args.dp if args.dp > 0 else len(devs)
+        if ndp > len(devs):
+            print(f"[racon_tpu::] error: --dp {ndp} exceeds the "
+                  f"{len(devs)} visible devices!", file=sys.stderr)
+            return 1
+        if args.distributed and ndp != len(devs):
+            # A mesh over devs[:ndp] would exclude some hosts' local
+            # devices, which the runtime rejects (or deadlocks on);
+            # multi-host meshes must span the global device set.
+            print(f"[racon_tpu::] error: --distributed requires --dp to "
+                  f"match the global device count ({len(devs)}); shard "
+                  "hosts with the wrapper instead (docs/DISTRIBUTED.md)",
+                  file=sys.stderr)
+            return 1
+        from jax.sharding import Mesh
+        mesh = Mesh(_np.asarray(devs[:ndp]), ("dp",))
+
     try:
         polisher = create_polisher(
             args.paths[0], args.paths[1], args.paths[2],
             PolisherType.kF if args.fragment_correction else PolisherType.kC,
             args.window_length, args.quality_threshold, args.error_threshold,
             args.match, args.mismatch, args.gap, backend=args.backend,
-            logger=logger, threads=args.threads)
+            logger=logger, threads=args.threads, mesh=mesh)
         polisher.initialize()
         polished = polisher.polish(not args.include_unpolished)
     except (PolisherError, ParseError, ValueError) as exc:

@@ -51,8 +51,13 @@ def create_polisher(sequences_path: str, overlaps_path: str,
                     error_threshold: float = 0.3, match: int = 5,
                     mismatch: int = -4, gap: int = -8,
                     backend: str = "auto", logger: Optional[Logger] = None,
-                    threads: int = 1) -> "Polisher":
-    """Validate options and dispatch parsers (src/polisher.cpp:51-130)."""
+                    threads: int = 1, mesh=None) -> "Polisher":
+    """Validate options and dispatch parsers (src/polisher.cpp:51-130).
+
+    ``mesh``: optional jax.sharding.Mesh with a "dp" axis — the consensus
+    engine shards every chunk's job axis over it (see
+    docs/DISTRIBUTED.md for single-host v5e-8 and multi-host recipes).
+    """
     if not isinstance(type_, PolisherType):
         raise PolisherError(
             "[racon_tpu::create_polisher] error: invalid polisher type!")
@@ -64,7 +69,8 @@ def create_polisher(sequences_path: str, overlaps_path: str,
     tparser = iop.create_sequence_parser(target_path)
     return Polisher(sparser, oparser, tparser, type_, window_length,
                     quality_threshold, error_threshold, match, mismatch,
-                    gap, backend=backend, logger=logger, threads=threads)
+                    gap, backend=backend, logger=logger, threads=threads,
+                    mesh=mesh)
 
 
 class Polisher:
@@ -73,7 +79,7 @@ class Polisher:
                  error_threshold: float, match: int, mismatch: int,
                  gap: int, backend: str = "auto",
                  logger: Optional[Logger] = None,
-                 window_chunk: int = 8192, threads: int = 1):
+                 window_chunk: int = 8192, threads: int = 1, mesh=None):
         self.sparser = sparser
         self.oparser = oparser
         self.tparser = tparser
@@ -85,7 +91,7 @@ class Polisher:
         # -t, src/polisher.cpp:341-364); device batching is unaffected.
         self.threads = threads
         self.engine = PoaEngine(match, mismatch, gap, backend=backend,
-                                threads=threads)
+                                threads=threads, mesh=mesh)
         self.logger = logger if logger is not None else NullLogger()
         self.window_chunk = window_chunk
 

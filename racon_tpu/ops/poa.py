@@ -124,8 +124,17 @@ class PoaEngine:
         self.backend = backend
         # Optional jax.sharding.Mesh: the device engine shards every
         # chunk's job axis over the mesh's "dp" devices
-        # (racon_tpu/ops/device_poa.py::device_round_sharded).
+        # (racon_tpu/ops/device_poa.py::device_round_sharded); with an
+        # "sp" axis, over-budget alignment jobs additionally route
+        # through the sequence-parallel NW (see _align).
         self.mesh = mesh
+        # Single-chip DP-matrix cell budget: above this, a job's dirs
+        # tensor would not fit the minimum device chunk (MAX_DIR_ELEMS
+        # at the 128-job bucket, racon_tpu/ops/device_poa.py) and the
+        # job routes to sp when an "sp" mesh axis exists. Overridable
+        # for tests.
+        from racon_tpu.ops.device_poa import MAX_DIR_ELEMS
+        self.sp_cell_budget = MAX_DIR_ELEMS // 128
         # OS threads for the native host aligner (reference -t).
         self.threads = threads
         # Optional dict: run_chunk accumulates phase wall times into it
@@ -398,10 +407,52 @@ class PoaEngine:
     def _align(self, jobs: List[_Job]) -> None:
         if not jobs:
             return
+        # Long-window routing (SURVEY.md "long-context"): when the mesh
+        # has an "sp" axis, jobs whose DP matrix exceeds a single chip's
+        # dirs budget align via the sequence-parallel NW (target axis
+        # sharded over "sp" chips, cross-chip traceback) instead of the
+        # host fallback — the windows themselves stay in this host
+        # merge, only their alignment scales out.
+        if (self.mesh is not None and
+                "sp" in getattr(self.mesh, "axis_names", ())):
+            sp_jobs = [j for j in jobs
+                       if len(j.q) * j.t_len > self.sp_cell_budget]
+            if sp_jobs:
+                self._align_sp(sp_jobs)
+                jobs = [j for j in jobs if j.ops is None]
+                if not jobs:
+                    return
         if self.backend == "native":
             self._align_native(jobs)
         else:
             self._align_jax(jobs)
+
+    @staticmethod
+    def _pack_jobs(jobs: List[_Job], B: int):
+        """Pad a job list into dense (q, t, lq, lt) batch arrays."""
+        Lq = _round_up(max(len(j.q) for j in jobs))
+        Lt = _round_up(max(j.t_len for j in jobs))
+        q = np.zeros((B, Lq), np.uint8)
+        t = np.zeros((B, Lt), np.uint8)
+        lq = np.ones(B, np.int32)
+        lt = np.ones(B, np.int32)
+        for b, j in enumerate(jobs):
+            lq[b] = len(j.q)
+            lt[b] = j.t_len
+            q[b, :lq[b]] = j.q
+            t[b, :lt[b]] = j.t
+        return q, t, lq, lt
+
+    def _align_sp(self, jobs: List[_Job]) -> None:
+        """Sequence-parallel alignment for over-budget jobs
+        (racon_tpu/parallel/dispatch.py::sp_nw_align)."""
+        from racon_tpu.parallel.dispatch import sp_nw_align
+        q, t, lq, lt = self._pack_jobs(jobs, len(jobs))
+        ops, n = sp_nw_align(self.mesh, q, t, lq, lt, match=self.match,
+                             mismatch=self.mismatch, gap=self.gap)
+        W = ops.shape[1]
+        for b, j in enumerate(jobs):
+            j.ops = ops[b, W - int(n[b]):]
 
     def _align_native(self, jobs: List[_Job]) -> None:
         from racon_tpu.native.aligner import NativeAligner
@@ -422,22 +473,12 @@ class PoaEngine:
         bs = self.device_batch
         for s in range(0, len(order), bs):
             chunk = [jobs[i] for i in order[s:s + bs]]
-            Lq = _round_up(max(len(j.q) for j in chunk))
-            Lt = _round_up(max(j.t_len for j in chunk))
             # Pad the batch dimension onto a coarse grid (512, 1024, 2048,
             # 3072, 4096) so chunks reuse a handful of compiled
             # executables per (Lq, Lt) bucket without paying full-batch
             # padding; padded rows are length-1 dummies.
             B = 512 if len(chunk) <= 512 else _round_up(len(chunk), 1024)
-            q = np.zeros((B, Lq), np.uint8)
-            t = np.zeros((B, Lt), np.uint8)
-            lq = np.ones(B, np.int32)
-            lt = np.ones(B, np.int32)
-            for b, j in enumerate(chunk):
-                lq[b] = len(j.q)
-                lt[b] = j.t_len
-                q[b, :lq[b]] = j.q
-                t[b, :lt[b]] = j.t
+            q, t, lq, lt = self._pack_jobs(chunk, B)
             from racon_tpu.ops.align import nw_align_auto
             ops, n = nw_align_auto(
                 q, t, lq, lt, match=self.match,

@@ -41,6 +41,54 @@ def test_bad_extension():
     assert b"unsupported format extension" in r.stderr
 
 
+def test_cli_dp_mesh_polishes(tmp_path):
+    """--dp N builds a data-parallel mesh and polishes through the
+    dp-sharded device engine (8 virtual CPU devices; the same sharding
+    the v5e-8 recipe in docs/DISTRIBUTED.md uses on real chips)."""
+    import os
+    import numpy as np
+    rng = np.random.default_rng(3)
+    bases = np.frombuffer(b"ACGT", np.uint8)
+    truth = bases[rng.integers(0, 4, 400)]
+
+    def noisy():
+        out = []
+        for b in truth:
+            r = rng.random()
+            if r < 0.03:
+                continue
+            out.append(int(rng.integers(0, 4)) if r < 0.06 else int(
+                np.searchsorted(bases, b)))
+        return bytes(bases[np.array(out)])
+
+    (tmp_path / "draft.fasta").write_bytes(
+        b">c1\n" + noisy() + b"\n")
+    reads, paf = [], []
+    dlen = len((tmp_path / "draft.fasta").read_bytes().split(b"\n")[1])
+    for i in range(8):
+        r = noisy()
+        reads.append(b">r%d\n%s\n" % (i, r))
+        paf.append(f"r{i}\t{len(r)}\t0\t{len(r)}\t+\tc1\t{dlen}\t0\t{dlen}"
+                   f"\t{min(len(r), dlen)}\t{max(len(r), dlen)}\t60")
+    (tmp_path / "reads.fasta").write_bytes(b"".join(reads))
+    (tmp_path / "ovl.paf").write_text("\n".join(paf) + "\n")
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    # The axon site hook (PYTHONPATH) re-points JAX_PLATFORMS at the
+    # TPU tunnel; drop it so the subprocess honors the CPU mesh.
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if "axon" not in p)
+    r = subprocess.run(
+        [sys.executable, "-m", "racon_tpu.cli", "--backend", "jax",
+         "--dp", "8", str(tmp_path / "reads.fasta"),
+         str(tmp_path / "ovl.paf"), str(tmp_path / "draft.fasta")],
+        capture_output=True, cwd="/root/repo", env=env)
+    assert r.returncode == 0, r.stderr.decode()[-2000:]
+    assert r.stdout.startswith(b">c1 LN:i:")
+
+
 @pytest.mark.slow
 def test_cli_polishes_to_stdout(ref_data):
     r = _run("--backend", "native",

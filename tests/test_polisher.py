@@ -166,8 +166,9 @@ def test_consensus_device_engine_golden_sam_fastq(ref_data_module,
 
     Measured 2026-07-30: ED 1305 on both the real TPU and the CPU XLA
     backend (bit-identical engines) — beats the reference golden. Runs
-    ~5-6 min on one CPU core, hence opt-in (-m ava); the default suite
-    covers the same engine differentially on small windows.
+    ~1.5 min on one CPU core since the column-walk rework; ci.sh runs it
+    explicitly in the default tier (the 'ava' marker only keeps it out
+    of bare `pytest tests/` invocations).
     """
     from racon_tpu.models.polisher import create_polisher
     p = create_polisher(
