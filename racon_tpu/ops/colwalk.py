@@ -27,11 +27,13 @@ the consumer of column j-1 in that step. Emissions are therefore already
 keyed by anchor position — extract_votes_cols consumes them with zero
 re-keying gathers.
 
-Exactness: ``up_run`` saturates at U_SAT (15). An optimal NW path with a
->=15-base insertion run costs >= 15*|gap| — essentially impossible on
-polishing windows — but correctness does not rest on that: saturated
-lanes raise a sticky flag and their windows are re-polished on the
-unbounded host path (the same redo route as the band escape bound).
+Exactness: ``up_run`` saturates at U_SAT (= device_merge.K_INS + 1), so
+a saturated counter exactly marks insertion runs longer than the K_INS
+pileup slots the device merge keeps. Such runs are rare on polishing
+windows (a run of length r costs r*|gap| against the anchor), and
+correctness does not rest on that: saturated lanes raise a sticky flag
+and their windows are re-polished on the unbounded host path (the same
+redo route as the band escape bound).
 ``consumer_dir`` propagates unsaturated, and a chain that reaches row 0
 stores LEFT — exactly the i==0 forced-LEFT walk of the legacy traceback
 (top-row deletions, reference edlib semantics at src/overlap.cpp:198).
@@ -80,8 +82,7 @@ def col_walk(cells, lq, lt, klo, t_off, *, LA: int, layout: str):
     lq = lq.astype(jnp.int32)
     t_off = t_off.astype(jnp.int32)
 
-    def step(carry, p):
-        i, sat = carry
+    def substep(i, sat, p):
         j = p - t_off
         active = (j >= 0) & (j <= lt)
         jc = jnp.clip(j, 0, lt)
@@ -104,28 +105,46 @@ def col_walk(cells, lq, lt, klo, t_off, *, LA: int, layout: str):
         is_j0 = active & (j == 0)
         # Gap j: the whole UP run in one step; at j == 0 every remaining
         # query base is a leading insertion (legacy walk's j==0 forcing).
-        # That run is exact (no cell read) but extract_votes_cols' window
-        # channels only span U_SAT weights, so longer leading runs must
-        # take the same redo route as saturated cells.
-        newsat = newsat | (is_j0 & (i > U_SAT))
+        # That run is exact (no cell read) but extract_votes_cols' pileup
+        # spans only U_SAT - 1 = K_INS columns, so leading runs longer
+        # than that take the same redo route as saturated cells.
+        newsat = newsat | (is_j0 & (i > U_SAT - 1))
         u_eff = jnp.where(is_j0, i, u)
         top = i - u_eff
         cons = jnp.where(top <= 0, LEFT, cdir)
         cons = jnp.where(is_j0, PAD_OP, cons)
         qi = top - jnp.where(cons == DIAG, 1, 0)
         i_next = jnp.where(active, jnp.where(is_j0, 0, qi), i)
-        sat = sat | newsat
+        out = jnp.stack([u_eff, top, cons, qi], axis=-1).astype(jnp.int16)
+        return i_next, sat | newsat, out
+
+    UNROLL = 4
+
+    def step(carry, p0):
+        # Several columns per scan iteration: the walk is a serialized
+        # chain of tiny per-column ops whose cost is per-iteration
+        # dispatch overhead, not arithmetic — unrolling divides the
+        # iteration count (PROFILE.md round 5).
+        i, sat = carry
+        outs = []
+        for k in reversed(range(UNROLL)):
+            i, sat, out = substep(i, sat, p0 + k)
+            outs.append(out)
         # ONE stacked int16 ys, not a tuple of int16 arrays: a reverse
         # scan emitting a TUPLE of int16 ys miscompiles under XLA CPU jit
         # in jax 0.9 (wrong values vs disable_jit; int32 tuples and
         # stacked int16 both compile correctly — verified empirically,
         # see tests/test_colwalk.py which would catch a recurrence).
-        out = jnp.stack([u_eff, top, cons, qi], axis=-1).astype(jnp.int16)
-        return (i_next, sat), out
+        return (i, sat), jnp.stack(outs[::-1], axis=0)
 
-    ps = jnp.arange(LA + 2, dtype=jnp.int32)
+    # Iteration count rounds up; an uneven grid's extra positions
+    # p > LA + 1 are provably inactive (t_off + lt <= LA for every lane)
+    # and are sliced off below.
+    T = (LA + 1 + UNROLL) // UNROLL
+    ps = jnp.arange(0, UNROLL * T, UNROLL, dtype=jnp.int32)
     (_, sat), ys = jax.lax.scan(
         step, (lq, jnp.zeros(lq.shape, bool)), ps, reverse=True)
-    ch = jnp.transpose(ys, (1, 0, 2))
+    # ys: [T, U, B, 4] with ys[t, k] describing p = U*t + k.
+    ch = jnp.transpose(ys.reshape(-1, B, 4), (1, 0, 2))[:, :LA + 2]
     return {"ins_len": ch[..., 0], "qstart": ch[..., 1],
             "op_c": ch[..., 2], "qi_c": ch[..., 3], "sat": sat}

@@ -36,13 +36,25 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from racon_tpu.ops.cigar import DIAG, UP, LEFT
 from racon_tpu.ops.flat import PAD_OP  # shared op padding marker
 from racon_tpu.ops.flat import U_SAT as _U_SAT
 from racon_tpu.ops.poa import _EPS as EPS  # shared tie-break epsilon
 
-K_INS = 8          # pileup columns per gap kept on device
+# Pileup columns per gap kept on device. Insertion runs longer than
+# K_INS raise the walk's sticky redo flag (flat.U_SAT = K_INS + 1) and
+# re-polish on the unbounded host path — unlike the former K=8/U_SAT=15
+# scheme, device output is never silently truncated. 10 is measured on
+# the reference lambda dataset: every window's max run is <= 10 (zero
+# redos), where K=8 would redo 8/96 windows and K=4 68/96.
+K_INS = 10
+# The contract above only holds when the walk's saturation threshold
+# tracks K (and extract_votes_cols' packed-word layout is hand-laid for
+# K = 10); fail loudly if either is retuned alone.
+assert _U_SAT == K_INS + 1, "flat.U_SAT must equal K_INS + 1"
+assert K_INS == 10, "extract_votes_cols' word layout is built for K=10"
 NBASE = 5          # A C G T N
 # Python int, NOT jnp.int32: a module-level jax.Array closed over by a
 # jitted function lowers as a hoisted buffer parameter on some traces, and
@@ -55,9 +67,9 @@ _HI = 2 ** 30
 _PREC = jax.lax.Precision.HIGHEST
 
 
-def _onehot(idx, depth):
+def _onehot(idx, depth, dtype=jnp.float32):
     return (idx[..., None] == jnp.arange(depth, dtype=idx.dtype)).astype(
-        jnp.float32)
+        dtype)
 
 
 def _take1(a, idx):
@@ -235,15 +247,21 @@ def extract_votes_cols(cols, q, qw8, w_read, lt, t_off, LA: int):
     traceback (racon_tpu/ops/colwalk.py) already emits ``ins_len /
     qstart / op_c / qi_c`` keyed by anchor position, so no re-keying
     gathers are needed; the only gather left is ONE merged query-window
-    read. Key fact: the consumer's query index ``qi`` differs from the
-    run start ``qstart`` by at most 1, so a single uint8 window of
-    offsets [-1, U_SAT) around qstart serves the column base/weight
-    (legacy gather #2), the k-shifted pileup channels (legacy gather #3)
-    and the run weight sum (legacy gather #4, now an in-register masked
-    sum — exact, since weights are integers and partial sums stay far
-    below 2^24). Per-call TPU gather dispatch costs ~35-45 ms at bench
-    shapes regardless of width (PROFILE.md), so going from 4 gathers +
-    flip + cumsums to 1 gather is the whole point.
+    read. Key facts: the consumer's query index ``qi`` differs from the
+    run start ``qstart`` by at most 1, and every unflagged insertion run
+    is at most K_INS bases (longer runs saturate the walk's up-run
+    counter at U_SAT = K_INS + 1 and take the host redo route), so the
+    window only spans K_INS + 1 query codes and K_INS + 1 weights around
+    qstart - 1. TPU gather cost scales with the number of gathered
+    ELEMENTS, not bytes (measured round 5, scripts/ablate_gather_pack.py:
+    a 26-channel u8 stacked gather costs ~100 ms at B=6144 where a
+    3-word i32 gather costs ~30 ms), so the window ships as FOUR packed
+    i32 words per query position: 11 base codes at 3 bits each and 11
+    weights at 7 bits each (weights are Phred + 1 <= 94 + 1 on any real
+    FASTQ — ChunkPlan clips the encoding at 126 accordingly). The run
+    weight sum is an in-register masked sum over the decoded window —
+    exact, since weights are integers and partial sums stay far below
+    2^24.
 
     Every channel value consumed downstream is bit-identical to
     extract_votes' (masked-out garbage may differ; all returned channels
@@ -272,51 +290,72 @@ def extract_votes_cols(cols, q, qw8, w_read, lt, t_off, LA: int):
     is_match = in_cols & (op_at == DIAG)
 
     # Merged query-window gather over the FULL LA+2 walk grid: offsets
-    # 0..K around qstart-1 for base codes, 0..U_SAT for weights (run_sum
-    # needs up to U_SAT weights). Gap consumers (pileup/run channels at
-    # anchor p) read row p; the column-p consumer's query index qi was
-    # emitted by walk step p+1 and satisfies qi in {qstart[p+1]-1,
-    # qstart[p+1]}, so its base/weight read row p+1 of the same gather.
+    # 0..K_INS around qstart-1, packed into FOUR i32 words per query
+    # position (see docstring — gather cost scales with element count).
+    # Word layout (QO = K_INS + 1 = 11 offsets):
+    #   word0: q[0..9]  at 3 bits each            (bits 0..29)
+    #   word1: w[0..3]  at 7 bits each | q[10]<<28 (bits 0..30)
+    #   word2: w[4..7]  at 7 bits each            (bits 0..27)
+    #   word3: w[8..10] at 7 bits each            (bits 0..20)
+    # Gap consumers (pileup/run channels at anchor p) read row p; the
+    # column-p consumer's query index qi was emitted by walk step p+1
+    # and satisfies qi in {qstart[p+1]-1, qstart[p+1]}, so its
+    # base/weight read row p+1 of the same gather.
     QO = K_INS + 1
-    WO = _U_SAT + 1
     qpad = jnp.concatenate(
-        [q, jnp.repeat(q[:, -1:], WO, axis=1)], axis=1)
-    wpad = jnp.concatenate(
-        [qw8, jnp.repeat(qw8[:, -1:], WO, axis=1)], axis=1)
-    stack = jnp.stack([qpad[:, o:o + Lq] for o in range(QO)] +
-                      [wpad[:, o:o + Lq] for o in range(WO)],
-                      axis=-1)                        # [B, Lq, QO+WO] u8
+        [q, jnp.repeat(q[:, -1:], QO, axis=1)], axis=1).astype(jnp.int32)
+    wpad = jnp.minimum(jnp.concatenate(
+        [qw8, jnp.repeat(qw8[:, -1:], QO, axis=1)], axis=1)
+        .astype(jnp.int32), 127)
+    word0 = sum((qpad[:, o:o + Lq] << (3 * o)) for o in range(10))
+    word1 = sum((wpad[:, o:o + Lq] << (7 * o)) for o in range(4)) \
+        + (qpad[:, 10:10 + Lq] << 28)
+    word2 = sum((wpad[:, o:o + Lq] << (7 * (o - 4))) for o in range(4, 8))
+    word3 = sum((wpad[:, o:o + Lq] << (7 * (o - 8))) for o in range(8, 11))
+    stack = jnp.stack([word0, word1, word2, word3], axis=-1)
     qs_full = cols["qstart"].astype(jnp.int32)        # [B, LA+2]
     qsc_full = jnp.clip(qs_full, 0, Lq - 1)
     s0_full = jnp.maximum(qsc_full - 1, 0)
     Gfull = jnp.take_along_axis(stack, s0_full[:, :, None], axis=1)
-    G = Gfull[:, :LA + 1]                             # gap rows (step p)
-    qwin = G[..., :QO].astype(jnp.int32)              # q[s0 + o]
-    wwin = jnp.maximum(G[..., QO:].astype(jnp.float32) - 1.0, 0.0)
+    Gg = Gfull[:, :LA + 1]                            # gap rows (step p)
+
+    def _q_at(g, o):
+        if o == 10:
+            return (g[..., 1] >> 28) & 7
+        return (g[..., 0] >> (3 * o)) & 7
+
+    def _w_at(g, o):
+        w, s = divmod(o, 4)
+        raw = (g[..., 1 + w] >> (7 * s)) & 127
+        return jnp.maximum(raw.astype(jnp.float32) - 1.0, 0.0)
+
     o1 = (qsc_full - s0_full)[:, :LA + 1] == 1
 
     def sel_q(o):
-        return jnp.where(o1, qwin[..., o + 1], qwin[..., o])
+        return jnp.where(o1, _q_at(Gg, o + 1), _q_at(Gg, o))
 
     def sel_w(o):
-        return jnp.where(o1, wwin[..., o + 1], wwin[..., o])
+        return jnp.where(o1, _w_at(Gg, o + 1), _w_at(Gg, o))
 
     Gc = Gfull[:, 1:]                                 # column rows (p+1)
     qi1 = (jnp.clip(qi, 0, Lq - 1) - s0_full[:, 1:]) == 1
-    colbase = jnp.where(qi1, Gc[..., 1], Gc[..., 0]).astype(jnp.int32)
-    colw = jnp.maximum(
-        jnp.where(qi1, Gc[..., QO + 1], Gc[..., QO])
-        .astype(jnp.float32) - 1.0, 0.0)
+    colbase = jnp.where(qi1, _q_at(Gc, 1), _q_at(Gc, 0))
+    colw = jnp.where(qi1, _w_at(Gc, 1), _w_at(Gc, 0))
     wq = jnp.where(is_match, colw, w_read[:, None])   # per-column weight
 
+    # Integer-valued channels (one-hot counts, integer Phred weights)
+    # are emitted in bfloat16 — exact for these values, and they ride
+    # aggregate_votes' cheap bf16 MXU matmul (see its docstring).
+    bf16 = jnp.bfloat16
     cols_m = in_cols[:, :LA]
     base_idx = jnp.where(is_match[:, :LA], colbase[:, :LA], NBASE)
     col_w = jnp.where(cols_m, jnp.where(is_match[:, :LA], colw[:, :LA],
                                         w_read[:, None]), 0.0)
     col_oh = _onehot(base_idx, NBASE + 1)
     col_w_ch = col_oh * col_w[..., None]                       # [B, LA, 6]
-    col_c_ch = col_oh[..., :NBASE] * (is_match[:, :LA] &
-                                      cols_m)[..., None]       # [B, LA, 5]
+    col_c_ch = (col_oh[..., :NBASE].astype(bf16) *
+                (is_match[:, :LA] &
+                 cols_m)[..., None].astype(bf16))              # [B, LA, 5]
 
     # Direct crossings: columns c-1 and c both consumed, no insertion.
     crossed = (c >= 1) & (c <= ltc - 1) & (ins_len == 0)
@@ -328,27 +367,29 @@ def extract_votes_cols(cols, q, qw8, w_read, lt, t_off, LA: int):
     multi = in_gaps & (ins_len >= 2)
     b1 = sel_q(0)
     w1 = sel_w(0)
-    ins1_oh = _onehot(jnp.where(has1, b1, NBASE), NBASE + 1)[..., :NBASE]
-    ins1_w_ch = ins1_oh * jnp.where(has1, w1, 0.0)[..., None]
-    ins1_c_ch = ins1_oh * has1[..., None]
-    ins1_stop = jnp.where(has1, w1, 0.0)
+    ins1_oh = _onehot(jnp.where(has1, b1, NBASE), NBASE + 1,
+                      bf16)[..., :NBASE]
+    ins1_w_ch = ins1_oh * jnp.where(has1, w1, 0.0)[..., None].astype(bf16)
+    ins1_c_ch = ins1_oh * has1[..., None].astype(bf16)
+    ins1_stop = jnp.where(has1, w1, 0.0).astype(bf16)
 
     # Pileup columns k = 0..K-1 for multi-base runs (no gathers).
     pk_w, pk_c = [], []
     for k in range(K_INS):
         inrun = multi & (ins_len > k)
-        oh = _onehot(jnp.where(inrun, sel_q(k), NBASE),
-                     NBASE + 1)[..., :NBASE]
-        pk_w.append(oh * jnp.where(inrun, sel_w(k), 0.0)[..., None])
-        pk_c.append(oh * inrun[..., None])
+        oh = _onehot(jnp.where(inrun, sel_q(k), NBASE), NBASE + 1,
+                     bf16)[..., :NBASE]
+        pk_w.append(oh * jnp.where(inrun, sel_w(k), 0.0)[..., None]
+                    .astype(bf16))
+        pk_c.append(oh * inrun[..., None].astype(bf16))
     pile_w_ch = jnp.stack(pk_w, axis=2)               # [B, LA+1, K, 5]
     pile_c_ch = jnp.stack(pk_c, axis=2)
 
     # Run mean weight -> stop-weight by run length (lengths 2..K); the
-    # full run weight sum comes from the same window (runs past U_SAT
+    # full run weight sum comes from the same window (runs past K_INS
     # never reach here — the walk's sat flag reroutes them).
     run_sum = sum(jnp.where(ins_len > k, sel_w(k), 0.0)
-                  for k in range(_U_SAT))
+                  for k in range(K_INS))
     wmean = jnp.where(multi, run_sum / jnp.maximum(ins_len, 1), 0.0)
     lw_oh = (jnp.clip(ins_len, 0, K_INS)[..., None] ==
              jnp.arange(2, K_INS + 1)[None, None, :])
@@ -368,25 +409,53 @@ def extract_votes_cols(cols, q, qw8, w_read, lt, t_off, LA: int):
 def aggregate_votes(votes, win, n_win: int, extras=None):
     """Sum per-job channels into per-window accumulators via one-hot
     matmul. ``extras``: optional dict of per-job [B] scalars summed per
-    window with the same membership matrix (returned under their keys)."""
+    window with the same membership matrix (returned under their keys).
+
+    Channels arriving in bfloat16 aggregate through a DEFAULT-precision
+    bf16 matmul with f32 accumulation — EXACT for their values, which
+    are one-hot 0/1 counts and integer Phred weights <= 126 (both
+    representable in bf16; MXU accumulation is f32 and per-window sums
+    stay far below 2^24) — at a fraction of the HIGHEST-precision f32
+    matmul the fractional channels (w_read-derived crossings, run-mean
+    length weights) still require. extract_votes_cols emits the integer
+    channels as bf16 for this reason; the all-f32 legacy extract_votes
+    path just lands every channel in the f32 group.
+    """
     B = win.shape[0]
     M = (jnp.arange(n_win, dtype=jnp.int32)[:, None] ==
-         win[None, :]).astype(jnp.float32)            # [Nw, B]
+         win[None, :])                                # [Nw, B] bool
+    M32 = M.astype(jnp.float32)
+    M16 = M.astype(jnp.bfloat16)
 
-    def agg(x):
-        flat = x.reshape(B, -1)
-        return jnp.matmul(M, flat, precision=_PREC).reshape(
-            (n_win,) + x.shape[1:])
+    def agg(xs):
+        """Concatenated matmul per dtype group; returns [Nw, L, C_total]
+        in the order of ``xs``."""
+        groups = {}
+        for x in xs:
+            groups.setdefault(x.dtype == jnp.bfloat16, []).append(x)
+        outs = {}
+        for is16, grp in groups.items():
+            flat = jnp.concatenate(grp, axis=-1).reshape(B, -1)
+            Lc = flat.shape[1] // grp[0].shape[1]
+            if is16:
+                o = jnp.matmul(M16, flat,
+                               preferred_element_type=jnp.float32)
+            else:
+                o = jnp.matmul(M32, flat, precision=_PREC)
+            outs[is16] = iter(jnp.split(
+                o.reshape(n_win, grp[0].shape[1], Lc),
+                np.cumsum([g.shape[-1] for g in grp])[:-1], axis=-1))
+        return jnp.concatenate(
+            [next(outs[x.dtype == jnp.bfloat16]) for x in xs], axis=-1)
 
-    col = agg(jnp.concatenate([votes["col_w"], votes["col_c"]], axis=-1))
-    gap = agg(jnp.concatenate(
-        [votes["cross_w"], votes["ins1_w"], votes["ins1_c"],
-         votes["ins1_stop"], votes["pile_w"], votes["pile_c"],
-         votes["lenw"]], axis=-1))
+    col = agg([votes["col_w"], votes["col_c"]])
+    gap = agg([votes["cross_w"], votes["ins1_w"], votes["ins1_c"],
+               votes["ins1_stop"], votes["pile_w"], votes["pile_c"],
+               votes["lenw"]])
     out = {}
     if extras:
         for k, v in extras.items():
-            out[k] = jnp.matmul(M, v[:, None], precision=_PREC)[:, 0]
+            out[k] = jnp.matmul(M32, v[:, None], precision=_PREC)[:, 0]
     out["base_w"] = col[..., :NBASE + 1]              # [Nw, LA, 6] (5=del)
     out["base_c"] = col[..., NBASE + 1:]              # [Nw, LA, 5]
     i = 0
@@ -426,15 +495,25 @@ def add_backbone(acc, bb, bbw, alen):
 
 
 def assemble(acc, alen, ins_scale: float):
-    """Vote out consensus into the padded slot layout + coordinate maps.
+    """Vote out consensus into a per-gap prefix layout + coordinate maps.
+
+    Emission at a gap stops permanently at the first pileup column that
+    loses to the stopped weight, so a gap's emitted insertion slots are
+    always a PREFIX of its K_INS columns; the layout is therefore fully
+    described by a per-gap emit count — no (LA+1)*(K+1) flat slot cumsum
+    needed (the former slot layout's searchsorted compaction was the
+    round's tail cost at K_INS = 10).
 
     Returns dict with:
-      codes  u8 [Nw, (LA+1)*(K+1)] slot codes (gap ins slots then column)
-      valid  bool same shape
-      cov    i32 same shape
-      total  i32 [Nw] new consensus lengths
-      pos    i32 [Nw, LA] landing position of each kept column
-      kept   bool [Nw, LA]
+      ins_codes i32 [Nw, LA+1, K] pileup winner codes
+      ins_cnt   i32 [Nw, LA+1, K] their coverage counts
+      e         i32 [Nw, LA+1] emitted insertion count per gap
+      col_code  i32 [Nw, LA] column winner code
+      col_cov   i32 [Nw, LA]
+      start     i32 [Nw, LA+1] output position of gap p's first slot
+      total     i32 [Nw] new consensus lengths
+      pos       i32 [Nw, LA] landing position of each kept column
+      kept      bool [Nw, LA]
     """
     base_w, base_c = acc["base_w"], acc["base_c"]
     Nw, LA, _ = base_c.shape
@@ -453,7 +532,8 @@ def assemble(acc, alen, ins_scale: float):
     # Gap emission: K sequential pileup columns (col 0 folds single runs).
     stopped = acc["direct_w"] * ins_scale
     emit_prev = vgap
-    ins_codes, ins_cnt, ins_emit = [], [], []
+    ins_codes, ins_cnt = [], []
+    e = jnp.zeros((Nw, LA + 1), jnp.int32)
     for k in range(K_INS):
         cw = acc["pile_w"][:, :, k, :]
         cc = acc["pile_c"][:, :, k, :]
@@ -465,8 +545,8 @@ def assemble(acc, alen, ins_scale: float):
         bk = jnp.argmax(cw, axis=-1)
         ck = jnp.take_along_axis(cc, bk[..., None], axis=-1)[..., 0]
         ins_codes.append(bk)
-        ins_cnt.append(ck)
-        ins_emit.append(em)
+        ins_cnt.append(ck.astype(jnp.int32))
+        e = e + em
         emit_prev = em
         # stopped += len_w[k+1] (+ single-run stops after column 0)
         if k == 0:
@@ -476,35 +556,22 @@ def assemble(acc, alen, ins_scale: float):
 
     ins_codes = jnp.stack(ins_codes, axis=2)          # [Nw, LA+1, K]
     ins_cnt = jnp.stack(ins_cnt, axis=2)
-    ins_emit = jnp.stack(ins_emit, axis=2)
 
-    # Slot layout per gap p: K insertion slots, then column p's slot.
-    col_slot_code = jnp.concatenate(
-        [best_code, jnp.zeros((Nw, 1), best_code.dtype)], axis=1)
-    col_slot_cov = jnp.concatenate(
-        [cov, jnp.zeros((Nw, 1), cov.dtype)], axis=1)
-    col_slot_valid = jnp.concatenate(
-        [kept, jnp.zeros((Nw, 1), bool)], axis=1)
-    codes = jnp.concatenate(
-        [ins_codes, col_slot_code[..., None]], axis=2)      # [Nw, LA+1, K+1]
-    covs = jnp.concatenate(
-        [ins_cnt, col_slot_cov[..., None]], axis=2).astype(jnp.int32)
-    valids = jnp.concatenate(
-        [ins_emit, col_slot_valid[..., None]], axis=2)
-
-    S = (LA + 1) * (K_INS + 1)
-    vflat = valids.reshape(Nw, S)
-    cum = jnp.cumsum(vflat, axis=1, dtype=jnp.int32)
-    total = cum[:, -1]
-
-    fi = p * (K_INS + 1) + K_INS                     # column p's flat slot
-    pos = _take1(cum, fi) - 1                        # landing pos (if kept)
+    # Unit p = gap p's emitted insertions, then column p (absent at LA).
+    ulen = e + jnp.concatenate(
+        [kept.astype(jnp.int32), jnp.zeros((Nw, 1), jnp.int32)], axis=1)
+    cum_u = jnp.cumsum(ulen, axis=1, dtype=jnp.int32)
+    start = cum_u - ulen                              # exclusive cumsum
+    total = cum_u[:, -1]
+    pos = start[:, :LA] + e[:, :LA]                   # column p's landing
 
     return {
-        "codes": codes.reshape(Nw, S).astype(jnp.uint8),
-        "valid": vflat,
-        "cum": cum,
-        "cov": covs.reshape(Nw, S),
+        "ins_codes": ins_codes,
+        "ins_cnt": ins_cnt,
+        "e": e,
+        "col_code": best_code.astype(jnp.int32),
+        "col_cov": cov.astype(jnp.int32),
+        "start": start,
         "total": total,
         "pos": pos,
         "kept": kept,
@@ -512,21 +579,36 @@ def assemble(acc, alen, ins_scale: float):
 
 
 def compact(asm, out_len: int):
-    """Gather-based stream compaction of the slot layout.
+    """Gather-based stream compaction of the per-gap prefix layout.
+
+    For output position j: its unit g = #{p : start[p] <= j} - 1 (start
+    is monotone), offset o = j - start[g]; the emitted symbol is pileup
+    column o of gap g while o < e[g], else column g's winner.
 
     Returns (codes u8 [Nw, out_len], cov i32 [Nw, out_len], total i32[Nw]).
-    Slots beyond ``total`` hold code 0 / cov 0.
+    Positions beyond ``total`` hold code 0 / cov 0.
     """
-    cum = asm["cum"]
-    Nw = cum.shape[0]
-    pp = jnp.arange(out_len, dtype=jnp.int32)
-    # searchsorted-left(cum, p+1) == count of cum entries < p+1.
-    inv = jnp.sum(cum[:, :, None] < (pp + 1)[None, None, :], axis=1,
-                  dtype=jnp.int32)
-    live = pp[None, :] < asm["total"][:, None]
-    codes = jnp.where(live, _take1(asm["codes"].astype(jnp.int32), inv), 0)
-    cov = jnp.where(live, _take1(asm["cov"], inv), 0)
-    return codes.astype(jnp.uint8), cov, asm["total"]
+    start, e, total = asm["start"], asm["e"], asm["total"]
+    Nw, LA1 = start.shape
+    jj = jnp.arange(out_len, dtype=jnp.int32)
+    # Count-leq over the monotone starts (the only O(LA^2) op left; it
+    # replaces the former count over (LA+1)*(K+1) slots).
+    g = jnp.sum(start[:, :, None] <= jj[None, None, :], axis=1,
+                dtype=jnp.int32) - 1
+    off = jj[None, :] - _take1(start, g)
+    eg = _take1(e, g)
+    is_ins = off < eg
+    K = asm["ins_codes"].shape[2]
+    flat_i = g * K + jnp.minimum(off, K - 1)
+    ins_code = _take1(asm["ins_codes"].reshape(Nw, LA1 * K), flat_i)
+    ins_cov = _take1(asm["ins_cnt"].reshape(Nw, LA1 * K), flat_i)
+    gc = jnp.minimum(g, LA1 - 2)                      # column g (g < LA)
+    col_code = _take1(asm["col_code"], gc)
+    col_cov = _take1(asm["col_cov"], gc)
+    live = jj[None, :] < total[:, None]
+    codes = jnp.where(live, jnp.where(is_ins, ins_code, col_code), 0)
+    cov = jnp.where(live, jnp.where(is_ins, ins_cov, col_cov), 0)
+    return codes.astype(jnp.uint8), cov, total
 
 
 def coord_maps(asm, alen, LA: int):

@@ -42,7 +42,14 @@ from racon_tpu.ops.flat import PAD_OP
 # Keep Lq * B * Lt under int32 flat-index range for the traceback gather.
 MAX_DIR_ELEMS = 1_600_000_000
 
-LA_GROW = 128      # anchor slack for insertion growth across rounds
+# Anchor slack for insertion growth across rounds. Consensus length
+# tracks backbone length within ~2% on real data; 64 covers that many
+# times over at w=500-class windows, and a window whose consensus DOES
+# outgrow the padded width raises the sticky ovf flag and re-polishes on
+# the unbounded host path — the slack is a throughput knob (walk steps,
+# vote channels, and merge gathers all scale with LA), not a correctness
+# bound.
+LA_GROW = 64
 
 
 def _round_up(n: int, mult: int) -> int:
@@ -74,11 +81,11 @@ _BAND_HISTORY: set = set()
 def run_caps(lq: int, la: int) -> Tuple[int, int]:
     """(lq_cap, la_cap) covering a run's max layer/backbone lengths, on a
     coarse grid."""
-    # LA pads on a 256 grid: backbone lengths cluster at the window
-    # length (~w..w+6%), and a 128 grid put typical runs right on a
-    # bucket boundary — two runs of the same workload (e.g. bench warmup
-    # vs measured) landed in different buckets and recompiled.
-    need = (_round_up(lq, 128), _round_up(la + LA_GROW, 256))
+    # LA pads on a 128 grid; with the 64-slot growth slack, runs of the
+    # same workload (e.g. bench warmup vs measured, lengths ~w..w+6%)
+    # still land in one bucket, and the former 256 grid wasted up to 20%
+    # of every LA-proportional cost (walk steps, channels, gathers).
+    need = (_round_up(lq, 128), _round_up(la + LA_GROW, 128))
     if 128 * need[0] * need[1] > MAX_DIR_ELEMS:
         # Unusable even at the minimum batch bucket (caller falls back to
         # the host path) — don't record it, or it would shadow smaller
@@ -197,7 +204,10 @@ class ChunkPlan:
             # paths agree by construction on CLI data. The clip stays as
             # defense-in-depth for direct-API Windows built with malformed
             # quality, where uint8 wrap would otherwise vote at max weight.
-            self.qw8[b, :ql] = np.clip(jobs_w[b], 0, 254).astype(np.uint8) + 1
+            # Cap 126: the vote extraction packs weights as 7-bit fields
+            # (device_merge.extract_votes_cols), and any real Phred weight
+            # is <= '~' - '!' = 93.
+            self.qw8[b, :ql] = np.clip(jobs_w[b], 0, 126).astype(np.uint8) + 1
             self.lq[b] = ql
             self.w_read[b] = float(jobs_w[b].astype(np.float64).mean()) \
                 if ql else 0.0
@@ -363,10 +373,17 @@ def _round_core(bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf, *,
                         layout="band_t" if pallas else "band")
         # Escape bound (see nw.cpp): banded score must beat any path
         # that leaves the band, else the lane's window is re-polished on
-        # the unbounded host path.
+        # the unbounded host path. Any out-of-band path carries at least
+        # |lt-lq| + 2(wl+1) gap ops; those consume query/target bases
+        # unpaired, so its diagonal-op count is at most
+        # min(lq,lt) - (wl+1) and its score at most
+        #   max(m,0)*(min(lq,lt) - wl - 1) + g*(|lt-lq| + 2wl + 2).
+        # (The former bound omitted the "- wl - 1" term; at narrow
+        # bands that looseness re-routed most REAL windows to the host:
+        # 92/96 lambda windows at W=128, round-5 measurement.)
         xend = jnp.clip(lt - lq - klo, 0, band_w - 1)
         score = jnp.take_along_axis(hlast, xend[:, None], axis=1)[:, 0]
-        bound = (jnp.maximum(match, 0) * jnp.minimum(lq, lt) +
+        bound = (jnp.maximum(match, 0) * (jnp.minimum(lq, lt) - wl - 1) +
                  gap * (jnp.abs(lt - lq) + 2 * wl + 2))
         esc_w = ((score < bound) | (wl < 16)).astype(jnp.float32)
     else:
@@ -434,6 +451,34 @@ device_round = functools.partial(
                      "n_win", "LA", "pallas", "band_w"))(_round_core)
 
 
+def _make_round_fn(*, match, mismatch, gap, ins_scale, Lq, n_win, LA,
+                   pallas, band_w, mesh):
+    """One round callable: plain _round_core, or its dp-sharded shard_map
+    when a mesh is given (the single place the sharding contract lives).
+
+    Job-axis arrays shard over "dp", window arrays replicate, and the
+    only collective is _round_core's one psum of the per-window vote
+    accumulators. check_vma=False: the Pallas kernels' out_shapes carry
+    no varying-mesh-axes annotation, which the checker (TPU path only)
+    rejects; the in/out specs below state the contract explicitly.
+    """
+    core = functools.partial(
+        _round_core, match=match, mismatch=mismatch, gap=gap,
+        ins_scale=ins_scale, Lq=Lq, n_win=n_win, LA=LA, pallas=pallas,
+        band_w=band_w, axis_name=None if mesh is None else "dp")
+    if mesh is None:
+        return core
+    import jax
+    from jax.sharding import PartitionSpec as P
+    rep = P()
+    job = P("dp")
+    return jax.shard_map(
+        core, mesh=mesh,
+        in_specs=(rep, rep, rep, job, job, job, job, job, job, job, rep),
+        out_specs=(rep, rep, rep, job, job, rep, rep),
+        check_vma=False)
+
+
 @functools.partial(
     __import__("jax").jit,
     static_argnames=("match", "mismatch", "gap", "ins_scale", "Lq",
@@ -476,27 +521,26 @@ def device_chunk_packed(job_buf, win_buf, *, match, mismatch, gap,
 
     ovf = jnp.zeros(n_win, dtype=bool)
     cov = None
-    if mesh is not None:
-        from jax.sharding import PartitionSpec as P
-        core = functools.partial(
-            _round_core, match=match, mismatch=mismatch, gap=gap,
-            ins_scale=ins_scale, Lq=Lq, n_win=n_win, LA=LA,
-            pallas=pallas, band_w=band_w, axis_name="dp")
-        rep = P()
-        job = P("dp")
-        rnd = jax.shard_map(
-            core, mesh=mesh,
-            in_specs=(rep, rep, rep, job, job, job, job, job, job, job,
-                      rep),
-            out_specs=(rep, rep, rep, job, job, rep, rep),
-            check_vma=False)
-    else:
-        rnd = functools.partial(
-            _round_core, match=match, mismatch=mismatch, gap=gap,
-            ins_scale=ins_scale, Lq=Lq, n_win=n_win, LA=LA,
-            pallas=pallas, band_w=band_w)
-    for _ in range(rounds):
-        bb, bbw, alen, begin, end, cov, ovf = rnd(
+
+    def make_round(bw):
+        return _make_round_fn(
+            match=match, mismatch=mismatch, gap=gap, ins_scale=ins_scale,
+            Lq=Lq, n_win=n_win, LA=LA, pallas=pallas, band_w=bw,
+            mesh=mesh)
+
+    for r in range(rounds):
+        # Round 0 aligns against the raw backbone and needs the full
+        # chunk band; later rounds align against a near-converged
+        # consensus whose spans were remapped through the previous
+        # merge, so the optimum hugs the diagonal and a narrower band
+        # suffices — exactness is still certified per lane per round by
+        # the escape bound, with failures taking the host redo route.
+        # 192 (not 128): at wl ~= 95 the tightened bound sits ~1000
+        # below real noisy-read scores, where W=128's wl ~= 63 made it
+        # marginal and re-routed 58/96 lambda windows (round-5
+        # measurement; Mosaic only needs W % 8, not % 128).
+        bw = band_w if (r == 0 or not band_w) else min(band_w, 192)
+        bb, bbw, alen, begin, end, cov, ovf = make_round(bw)(
             bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf)
     return _pack_body(bb[:-1], cov, alen[:-1], ovf)
 
@@ -515,23 +559,10 @@ def device_round_sharded(bb, bbw, alen, begin, end, q, qw8, lq, w_read,
     accumulators, and the (replicated) assembly/compaction runs
     redundantly per chip — zero-collective except that psum, as windows
     are independent (SURVEY.md section 7 step 6)."""
-    import jax
-    from jax.sharding import PartitionSpec as P
-
-    core = functools.partial(
-        _round_core, match=match, mismatch=mismatch, gap=gap,
-        ins_scale=ins_scale, Lq=Lq, n_win=n_win, LA=LA,
-        pallas=pallas, band_w=band_w, axis_name="dp")
-    rep = P()
-    job = P("dp")
-    # check_vma=False: the Pallas kernels' out_shapes carry no varying-
-    # mesh-axes annotation, which the checker (TPU path only) rejects;
-    # the in/out specs above state the sharding contract explicitly.
-    fn = jax.shard_map(
-        core, mesh=mesh,
-        in_specs=(rep, rep, rep, job, job, job, job, job, job, job, rep),
-        out_specs=(rep, rep, rep, job, job, rep, rep),
-        check_vma=False)
+    fn = _make_round_fn(
+        match=match, mismatch=mismatch, gap=gap, ins_scale=ins_scale,
+        Lq=Lq, n_win=n_win, LA=LA, pallas=pallas, band_w=band_w,
+        mesh=mesh)
     return fn(bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf)
 
 

@@ -27,7 +27,17 @@ from racon_tpu.ops.cigar import DIAG, UP, LEFT
 
 PAD_OP = 3
 _NEG = -(2 ** 30)
-U_SAT = 15  # UP-run saturation in the packed cell byte (4 bits)
+# UP-run saturation in the packed cell byte (4-bit field; single source
+# of truth — the Pallas kernels import it). Set to device_merge.K_INS + 1
+# so a saturated counter (u == U_SAT) exactly marks runs LONGER than the
+# K_INS pileup slots the device merge keeps; such lanes raise the sticky
+# redo flag and their windows re-polish on the unbounded host path. Small
+# U_SAT is a throughput lever: the vote extraction's packed-word gather
+# spans K_INS + 1 = U_SAT query/weight offsets (device_merge.py). 11 is
+# the measured sweet spot on the reference lambda dataset: per-window max
+# insertion-run length is <= 10 on all 96 windows (so zero redos), while
+# 8 would redo 8/96 and 5 would redo 68/96 (round-5 measurement).
+U_SAT = 11
 
 
 @functools.partial(jax.jit, static_argnames=("match", "mismatch", "gap"))

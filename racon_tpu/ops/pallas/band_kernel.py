@@ -53,7 +53,7 @@ _NEG = -(2 ** 30)
 _NEG16 = -16384  # int16 kernel's -inf (see _score_dtype for the proof)
 TB = 128   # jobs per grid program (sublanes)
 CH = 32    # query rows per grid step
-U_SAT = 15  # UP-run saturation in the packed cell byte (4 bits)
+from racon_tpu.ops.flat import U_SAT  # single source (= K_INS + 1)
 
 
 def _score_dtype(match: int, mismatch: int, gap: int, Lq: int, W: int):

@@ -23,7 +23,7 @@ from racon_tpu.ops.cigar import DIAG, UP, LEFT
 _NEG = -(2 ** 30)
 TB = 128   # jobs per grid program
 CH = 32    # query rows per grid step
-U_SAT = 15  # UP-run saturation in the packed cell byte (4 bits)
+from racon_tpu.ops.flat import U_SAT  # single source (= K_INS + 1)
 
 
 def _kernel(tbuf_ref, qT_ref, dirs_ref, prev_ref, uprev_ref, cprev_ref, *,

@@ -1,0 +1,52 @@
+"""Ablation: device_chunk_packed windows/s at B=4096 vs B=8192.
+
+The column walk is a serialized chain whose per-iteration cost is
+dispatch overhead + one [B] gather; doubling B amortizes it over twice
+the lanes if the gather is latency-bound. Usage:
+python scripts/ablate_chunk_b.py [n_windows_per_chunk ...]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    import jax
+    from bench import build_windows
+    from racon_tpu.ops.device_poa import (ChunkPlan, run_caps, _use_pallas,
+                                          device_chunk_packed)
+
+    sizes = [int(a) for a in sys.argv[1:]] or [128, 256]
+    print(f"backend={jax.default_backend()}")
+    for n in sizes:
+        sub = build_windows(n, 30, 500, seed=3)
+        lqm = max(max(len(d) for d in w.layer_data) for w in sub)
+        lam = max(len(w.backbone) for w in sub)
+        lq_cap, la_cap = run_caps(lqm, lam)
+        plan = ChunkPlan(sub, lq_cap=lq_cap, la_cap=la_cap)
+        job_h, win_h = plan.packed_bufs()
+        job_buf, win_buf = jax.device_put((job_h, win_h))
+        kw = dict(match=5, mismatch=-4, gap=-8, ins_scale=0.3,
+                  Lq=plan.Lq, n_win=plan.n_win, LA=plan.LA,
+                  pallas=_use_pallas(plan.B, plan.Lq, plan.LA),
+                  band_w=plan.band_w, rounds=4)
+        out = device_chunk_packed(job_buf, win_buf, **kw)
+        np.asarray(out[:1])
+        reps = 3
+        t1 = time.perf_counter()
+        for _ in range(reps):
+            out = device_chunk_packed(job_buf, win_buf, **kw)
+        np.asarray(out[:1])
+        dt = (time.perf_counter() - t1) / reps
+        print(f"n_win={n:4d} B={plan.B} Lq={plan.Lq} LA={plan.LA} "
+              f"W={plan.band_w}: {dt*1000:.0f} ms/chunk = "
+              f"{n/dt:.1f} windows/s", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,78 @@
+"""Micro-bench: does packing gathered u8 channels into i32 words cut
+TPU gather cost proportionally to element count?
+
+Variants at B=6144, Lq=656, P=770:
+  a) [B, Lq, 26] u8 axis-1 gather (current extract_votes_cols shape)
+  b) [B, Lq, 7] i32 packed words, same index
+  c) [B, Lq, 3] i32 (the K_INS=4 / U_SAT=7 target shape)
+  d) 3 separate [B, Lq] i32 2D gathers
+  e) [B, Lq] i32 single 2D gather (baseline per-call cost)
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def t(fn, *args, reps=10):
+    """Chained dispatch, single trailing sync (PROFILE.md timing rule)."""
+    np.asarray(fn(*args))                      # compile + settle
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(reps):
+        out = fn(*args)
+    np.asarray(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    B, Lq, P = 6144, 656, 770
+    rng = np.random.default_rng(0)
+    s8 = jnp.asarray(rng.integers(0, 256, (B, Lq, 26)).astype(np.uint8))
+    s32_7 = jnp.asarray(rng.integers(0, 2**20, (B, Lq, 7)).astype(np.int32))
+    s32_3 = jnp.asarray(rng.integers(0, 2**20, (B, Lq, 3)).astype(np.int32))
+    s32_1 = jnp.asarray(rng.integers(0, 2**20, (B, Lq)).astype(np.int32))
+    idx = jnp.asarray(
+        np.clip(np.tile(np.arange(P), (B, 1)) - 10, 0, Lq - 1)
+        .astype(np.int32))
+
+    @jax.jit
+    def g_a(s, idx):
+        return jnp.sum(jnp.take_along_axis(
+            s, idx[:, :, None], axis=1).astype(jnp.int32))
+
+    @jax.jit
+    def g_b(s, idx):
+        return jnp.sum(jnp.take_along_axis(s, idx[:, :, None], axis=1))
+
+    @jax.jit
+    def g_d(s, idx):
+        return sum(jnp.sum(jnp.take_along_axis(s[..., k], idx, axis=1))
+                   for k in range(3))
+
+    @jax.jit
+    def g_e(s, idx):
+        return jnp.sum(jnp.take_along_axis(s, idx, axis=1))
+
+    @jax.jit
+    def g_noop(s, idx):
+        return jnp.sum(idx)
+
+    print(f"backend={jax.default_backend()}")
+    print(f"noop    : {t(g_noop, s32_1, idx):.3f}s")
+    print(f"a u8x26 : {t(g_a, s8, idx):.3f}s")
+    print(f"b i32x7 : {t(g_b, s32_7, idx):.3f}s")
+    print(f"c i32x3 : {t(g_b, s32_3, idx):.3f}s")
+    print(f"d 3x2D  : {t(g_d, s32_3, idx):.3f}s")
+    print(f"e 1x2D  : {t(g_e, s32_1, idx):.3f}s")
+
+
+if __name__ == "__main__":
+    main()

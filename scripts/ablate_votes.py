@@ -1,0 +1,170 @@
+"""Ablation: where extract_votes_cols spends its time on the real TPU.
+
+profile_engine.py (round-5) shows the votes stage dominating a round at
+larger B (+188 ms at B=6144 vs +59 ms for the column walk). This script
+times jitted prefixes of extract_votes_cols at bench-like shapes with
+synthetic walk outputs, so each sub-piece's marginal cost is visible.
+
+Usage: python scripts/ablate_votes.py [B]
+"""
+
+import os
+import sys
+import time
+import functools
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def t(fn, *args, reps=3, **kw):
+    out = np.asarray(fn(*args, **kw))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = np.asarray(fn(*args, **kw))
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from racon_tpu.ops.device_merge import NBASE, K_INS, _onehot
+    from racon_tpu.ops.cigar import DIAG
+    from racon_tpu.ops.flat import U_SAT as _U_SAT
+
+    B = int(sys.argv[1]) if len(sys.argv) > 1 else 6144
+    Lq, LA = 640, 768
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.integers(0, 4, (B, Lq)).astype(np.uint8))
+    qw8 = jnp.asarray(rng.integers(1, 60, (B, Lq)).astype(np.uint8))
+    w_read = jnp.asarray(rng.random(B).astype(np.float32) * 30)
+    lt = jnp.asarray(rng.integers(450, 530, B).astype(np.int32))
+    t_off = jnp.zeros(B, jnp.int32)
+    cols = {
+        "ins_len": jnp.asarray(
+            (rng.random((B, LA + 2)) < 0.03).astype(np.int16)),
+        "qstart": jnp.asarray(
+            np.clip(np.tile(np.arange(LA + 2), (B, 1)) - 10, 0, Lq - 1)
+            .astype(np.int16)),
+        "op_c": jnp.asarray(rng.choice([0, 1, 2], (B, LA + 2),
+                                       p=[0.9, 0.05, 0.05])
+                            .astype(np.int16)),
+        "qi_c": jnp.asarray(
+            np.clip(np.tile(np.arange(LA + 2), (B, 1)) - 9, 0, Lq - 1)
+            .astype(np.int16)),
+        "sat": jnp.zeros(B, bool),
+    }
+
+    @functools.partial(jax.jit, static_argnames=("upto",))
+    def stage(cols, q, qw8, w_read, lt, t_off, *, upto):
+        ltc = lt[:, None]
+        pa = jnp.arange(LA + 1, dtype=jnp.int32)[None, :]
+        c = pa - t_off[:, None]
+        in_cols = (c >= 0) & (c < ltc)
+        in_gaps = (c >= 0) & (c <= ltc)
+        ins_len = jnp.where(in_gaps, cols["ins_len"][:, :LA + 1]
+                            .astype(jnp.int32), 0)
+        op_at = cols["op_c"][:, 1:].astype(jnp.int32)
+        qi = cols["qi_c"][:, 1:].astype(jnp.int32)
+        is_match = in_cols & (op_at == DIAG)
+
+        QO = K_INS + 1
+        WO = _U_SAT + 1
+        qpad = jnp.concatenate(
+            [q, jnp.repeat(q[:, -1:], WO, axis=1)], axis=1)
+        wpad = jnp.concatenate(
+            [qw8, jnp.repeat(qw8[:, -1:], WO, axis=1)], axis=1)
+        stack = jnp.stack([qpad[:, o:o + Lq] for o in range(QO)] +
+                          [wpad[:, o:o + Lq] for o in range(WO)],
+                          axis=-1)
+        qs_full = cols["qstart"].astype(jnp.int32)
+        qsc_full = jnp.clip(qs_full, 0, Lq - 1)
+        s0_full = jnp.maximum(qsc_full - 1, 0)
+        Gfull = jnp.take_along_axis(stack, s0_full[:, :, None], axis=1)
+        if upto == "gather":
+            return jnp.sum(Gfull.astype(jnp.int32))
+        G = Gfull[:, :LA + 1]
+        qwin = G[..., :QO].astype(jnp.int32)
+        wwin = jnp.maximum(G[..., QO:].astype(jnp.float32) - 1.0, 0.0)
+        o1 = (qsc_full - s0_full)[:, :LA + 1] == 1
+
+        def sel_q(o):
+            return jnp.where(o1, qwin[..., o + 1], qwin[..., o])
+
+        def sel_w(o):
+            return jnp.where(o1, wwin[..., o + 1], wwin[..., o])
+
+        Gc = Gfull[:, 1:]
+        qi1 = (jnp.clip(qi, 0, Lq - 1) - s0_full[:, 1:]) == 1
+        colbase = jnp.where(qi1, Gc[..., 1], Gc[..., 0]).astype(jnp.int32)
+        colw = jnp.maximum(
+            jnp.where(qi1, Gc[..., QO + 1], Gc[..., QO])
+            .astype(jnp.float32) - 1.0, 0.0)
+        wq = jnp.where(is_match, colw, w_read[:, None])
+
+        cols_m = in_cols[:, :LA]
+        base_idx = jnp.where(is_match[:, :LA], colbase[:, :LA], NBASE)
+        col_w = jnp.where(cols_m, jnp.where(is_match[:, :LA], colw[:, :LA],
+                                            w_read[:, None]), 0.0)
+        col_oh = _onehot(base_idx, NBASE + 1)
+        col_w_ch = col_oh * col_w[..., None]
+        col_c_ch = col_oh[..., :NBASE] * (is_match[:, :LA] &
+                                          cols_m)[..., None]
+        if upto == "col":
+            return jnp.sum(col_w_ch) + jnp.sum(col_c_ch)
+
+        crossed = (c >= 1) & (c <= ltc - 1) & (ins_len == 0)
+        wq_prev = jnp.concatenate([w_read[:, None], wq[:, :LA]], axis=1)
+        cross_w = jnp.where(crossed, 0.5 * (wq_prev + wq), 0.0)
+        has1 = in_gaps & (ins_len == 1)
+        multi = in_gaps & (ins_len >= 2)
+        b1 = sel_q(0)
+        w1 = sel_w(0)
+        ins1_oh = _onehot(jnp.where(has1, b1, NBASE),
+                          NBASE + 1)[..., :NBASE]
+        ins1_w_ch = ins1_oh * jnp.where(has1, w1, 0.0)[..., None]
+        ins1_c_ch = ins1_oh * has1[..., None]
+        ins1_stop = jnp.where(has1, w1, 0.0)
+        if upto == "ins1":
+            return (jnp.sum(col_w_ch) + jnp.sum(col_c_ch) +
+                    jnp.sum(cross_w) + jnp.sum(ins1_w_ch) +
+                    jnp.sum(ins1_c_ch) + jnp.sum(ins1_stop))
+
+        pk_w, pk_c = [], []
+        for k in range(K_INS):
+            inrun = multi & (ins_len > k)
+            oh = _onehot(jnp.where(inrun, sel_q(k), NBASE),
+                         NBASE + 1)[..., :NBASE]
+            pk_w.append(oh * jnp.where(inrun, sel_w(k), 0.0)[..., None])
+            pk_c.append(oh * inrun[..., None])
+        pile_w_ch = jnp.stack(pk_w, axis=2)
+        pile_c_ch = jnp.stack(pk_c, axis=2)
+        if upto == "pile":
+            return (jnp.sum(col_w_ch) + jnp.sum(col_c_ch) +
+                    jnp.sum(cross_w) + jnp.sum(ins1_w_ch) +
+                    jnp.sum(ins1_c_ch) + jnp.sum(pile_w_ch) +
+                    jnp.sum(pile_c_ch))
+
+        run_sum = sum(jnp.where(ins_len > k, sel_w(k), 0.0)
+                      for k in range(_U_SAT))
+        wmean = jnp.where(multi, run_sum / jnp.maximum(ins_len, 1), 0.0)
+        lw_oh = (jnp.clip(ins_len, 0, K_INS)[..., None] ==
+                 jnp.arange(2, K_INS + 1)[None, None, :])
+        lenw_ch = lw_oh * (wmean * multi)[..., None]
+        return (jnp.sum(col_w_ch) + jnp.sum(col_c_ch) +
+                jnp.sum(cross_w) + jnp.sum(ins1_w_ch) +
+                jnp.sum(ins1_c_ch) + jnp.sum(ins1_stop) +
+                jnp.sum(pile_w_ch) + jnp.sum(pile_c_ch) +
+                jnp.sum(lenw_ch))
+
+    print(f"backend={jax.default_backend()} B={B} Lq={Lq} LA={LA}")
+    prev = 0.0
+    for upto in ("gather", "col", "ins1", "pile", "runsum"):
+        dt = t(stage, cols, q, qw8, w_read, lt, t_off, upto=upto)
+        print(f"{upto:7s}: {dt:.3f}s (+{dt - prev:.3f}s)", flush=True)
+        prev = dt
+
+
+if __name__ == "__main__":
+    main()
