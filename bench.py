@@ -86,6 +86,8 @@ def build_windows(n_windows: int, coverage: int, wlen: int, seed: int = 0):
 
 
 def main():
+    from racon_tpu.utils.jaxcache import enable_compile_cache
+    enable_compile_cache()
     n_windows = int(sys.argv[1]) if len(sys.argv) > 1 else 512
     coverage = int(sys.argv[2]) if len(sys.argv) > 2 else 30
     wlen = 500
@@ -136,7 +138,8 @@ def main():
         job_h, win_h = plan.packed_bufs()
         job_buf, win_buf = jax.device_put((job_h, win_h))
         kw = dict(match=5, mismatch=-4, gap=-8,
-                  ins_scale=eng._eff_ins_scale, Lq=plan.Lq,
+                  ins_scale=tuple(eng._round_scales(eng.refine_rounds + 1)),
+                  Lq=plan.Lq,
                   n_win=plan.n_win, LA=plan.LA,
                   pallas=_use_pallas(plan.B, plan.Lq, plan.LA),
                   band_w=plan.band_w, rounds=eng.refine_rounds + 1)

@@ -82,6 +82,9 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv: Optional[List[str]] = None) -> int:
     ap = build_parser()
     args = ap.parse_args(argv)
+    # After parse_args: --help/usage errors should not pay a jax import.
+    from racon_tpu.utils.jaxcache import enable_compile_cache
+    enable_compile_cache()
 
     if args.version:
         print(f"v{__version__}")

@@ -225,9 +225,24 @@ class Polisher:
             seq.transmute(has_name[i], has_data[i], has_reverse[i])
 
         # 5. Breaking points; PAF/MHAP overlaps need a global alignment
-        # first — one batched native call replaces the per-overlap edlib
-        # fan-out (src/polisher.cpp:351-364, overlap.cpp:194-213).
+        # first. With a device backend the whole phase runs as batched
+        # banded NW on the TPU and the breaking points are reduced on
+        # device (racon_tpu/ops/ovl_align.py — at genome scale this
+        # phase dominated initialize on the host: 551 s of a 1325 s
+        # 2 Mb/30x run on one core); over-budget or uncertified lanes
+        # fall back to the batched native call, which also serves the
+        # CPU backend outright (src/polisher.cpp:351-364,
+        # overlap.cpp:194-213).
         pending = [o for o in overlaps if len(o.cigar) == 0]
+        if pending and self.engine.backend == "jax":
+            from racon_tpu.ops.ovl_align import device_breaking_points
+            # Edit-distance scoring (0, -1, -1): the reference derives
+            # overlap CIGARs with edlib (src/overlap.cpp:198-200), and
+            # the native fallback below uses the same NativeAligner
+            # defaults — all three paths pick the same alignments.
+            pending = device_breaking_points(
+                pending, self.sequences, self.window_length,
+                match=0, mismatch=-1, gap=-1, log=sys.stderr)
         if pending:
             from racon_tpu.native.aligner import NativeAligner
             from racon_tpu.ops.cigar import ops_to_cigar
@@ -266,33 +281,46 @@ class Polisher:
             id_to_first_window[i + 1] = id_to_first_window[i] + k
 
         # 7. Route overlap segments into windows with the 2%-span and
-        # mean-quality filters (src/polisher.cpp:390-446).
+        # mean-quality filters (src/polisher.cpp:390-446). Filters and
+        # window arithmetic run vectorized over each overlap's breaking-
+        # point rows (at genome scale this loop sees tens of millions of
+        # rows — the per-row Python of earlier rounds dominated
+        # initialize); only surviving rows pay Python list appends.
         self.targets_coverages = [0] * targets_size
+        min_span = 0.02 * w_len
         for o in overlaps:
             self.targets_coverages[o.t_id] += 1
             seq = self.sequences[o.q_id]
             bps = o.breaking_points
-            if bps is None:
+            if bps is None or len(bps) == 0:
+                o.breaking_points = None
                 continue
             data = seq.reverse_complement if o.strand else seq.data
             qual = seq.reverse_quality if o.strand else seq.quality
             dmv = memoryview(data) if data is not None else None
             qmv = memoryview(qual) if qual is not None else None
-            for first_t, first_q, last_t1, last_q1 in bps:
-                if last_q1 - first_q < 0.02 * w_len:
-                    continue
-                if qual is not None:
-                    avg = seq.mean_quality(int(first_q), int(last_q1),
-                                           reverse=o.strand)
-                    if avg is not None and avg < self.quality_threshold:
-                        continue
-                window_id = id_to_first_window[o.t_id] + first_t // w_len
-                window_start = (first_t // w_len) * w_len
-                self.windows[window_id].add_layer(
-                    dmv[first_q:last_q1],
-                    qmv[first_q:last_q1] if qmv is not None else None,
-                    int(first_t - window_start),
-                    int(last_t1 - window_start - 1))
+            first_t = bps[:, 0]
+            first_q = bps[:, 1]
+            last_q1 = bps[:, 3]
+            ok = (last_q1 - first_q) >= min_span
+            if qual is not None:
+                pref = seq.quality_prefix(o.strand)
+                if pref is not None:
+                    n_b = last_q1 - first_q
+                    avg = (pref[last_q1] - pref[first_q]) / \
+                        np.maximum(n_b, 1)
+                    ok &= ~((avg < self.quality_threshold) & (n_b > 0))
+            wslot = first_t // w_len
+            wid = id_to_first_window[o.t_id] + wslot
+            wstart = wslot * w_len
+            b = first_t - wstart
+            e = bps[:, 2] - wstart - 1
+            for r in np.flatnonzero(ok):
+                self.windows[wid[r]].add_layer(
+                    dmv[first_q[r]:last_q1[r]],
+                    qmv[first_q[r]:last_q1[r]] if qmv is not None
+                    else None,
+                    int(b[r]), int(e[r]))
             o.breaking_points = None  # freed (src/polisher.cpp:445)
 
         log.phase("[racon_tpu::Polisher::initialize] "
@@ -308,12 +336,6 @@ class Polisher:
         log.begin()
 
         n_windows = len(self.windows)
-        # Fix the weight-regime calibration from the run-global layer
-        # counts so window chunking cannot flip it mid-run.
-        self.engine.set_weight_regime(
-            sum(1 for w in self.windows for q in w.layer_quality
-                if q is not None),
-            sum(w.n_layers for w in self.windows))
         for s in range(0, n_windows, self.window_chunk):
             self.engine.consensus_windows(self.windows[s:s + self.window_chunk])
             log.tick("[racon_tpu::Polisher::polish] generating consensus")

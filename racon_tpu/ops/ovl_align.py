@@ -1,0 +1,290 @@
+"""Device-side overlap alignment: PAF/MHAP breaking points on the TPU.
+
+The reference aligns every CIGAR-less overlap with edlib on a CPU thread
+pool, then walks the CIGAR base by base to find per-window breaking
+points (src/polisher.cpp:351-364, src/overlap.cpp:179-282). At genome
+scale this phase dominates initialize: 551 s of a 1325 s 2 Mb/30x run
+on this image's single core (scripts/genome_bench.py, round 5).
+
+TPU restructuring: overlaps batch through the same banded NW forward
+kernel as window consensus (racon_tpu/ops/pallas/band_kernel.py), the
+column-walk traceback (racon_tpu/ops/colwalk.py) yields the consuming
+op + query index per TARGET column, and the breaking points fall out as
+per-window first/last-match reductions over that column grid — no CIGAR
+string ever materializes, and only [B, NW, 4] breaking-point rows leave
+the device (a CIGAR d2h would be ~Lq+Lt bytes per overlap through the
+tunnel).
+
+Exactness contract (same as the consensus engine): per-lane banded
+optimality is certified by the tightened escape bound; lanes that fail
+it — or whose walk saturated an up-run counter — are returned to the
+caller for the native aligner fallback. Jobs too long for the device
+budget (band width must grow ~Lq/7 to certify at ONT error rates, and
+128 * Lq * W is capped by the int32 flat-index budget, so ~9 kb is the
+practical ceiling) skip the device entirely.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import functools
+
+import numpy as np
+
+from racon_tpu.ops.cigar import DIAG
+from racon_tpu.ops.device_poa import _round_up
+from racon_tpu.ops.pallas.band_kernel import TB   # lane grid (= chunk B)
+# Dirs-tensor element budget: the column walk's flat gather index must
+# stay under 2^31 and the dirs HBM buffer under the TPU's 2 GB
+# single-buffer ceiling. 1.9e9 leaves margin for both while admitting
+# the 8 kb-read genome geometry (128 x 8192 x 1536 = 1.61e9 — the
+# consensus engine's tighter 1.6e9 cap rejected it by 0.7% and silently
+# routed EVERY genome overlap to the native path, round-5 find).
+MAX_DIR_ELEMS = 1_900_000_000
+
+_VMEM_BUDGET = 12 * 1024 * 1024   # usable of the 16 MiB scoped limit
+
+
+def _vmem_est(W: int, Lq: int, ch: int) -> int:
+    """Band-kernel VMEM block-byte model at long-read geometry: the
+    (W+Lq, 128) int32 target window (int16 would halve it, but Mosaic
+    requires 8-aligned dynamic sublane slices below 32 bits), the
+    double-buffered (ch, W, 128) u8 dirs block, and four W-tall
+    128-lane i32 rows (prev + packed UC scratch + hlast + working row).
+    Lane blocks always pad to 128 on TPU, so shrinking the batch below
+    128 lanes saves nothing — ch and the admission cap are the only
+    levers."""
+    return 128 * (4 * (W + Lq) + W * (2 * ch + 16))
+
+
+def _pick_tiles(W: int, Lq: int) -> Tuple[int, int]:
+    """(tb, ch) for the band kernel: full 128 lanes, row tile shrunk
+    until the VMEM model fits (admission guarantees ch=8 fits)."""
+    for ch in (32, 8):
+        if Lq % ch == 0 and _vmem_est(W, Lq, ch) <= _VMEM_BUDGET:
+            return TB, ch
+    return TB, 8
+
+
+def band_width_for_read(lq: int, lt: int) -> int:
+    """Band width that certifies noisy long-read alignments.
+
+    At edit-distance scoring (m=0, g=-1 — edlib parity) the tightened
+    escape bound certifies iff ED_banded <= |lt-lq| + 2*wl + 2, so the
+    half-width must exceed half the expected edit distance: read-vs-
+    draft difference runs ~12-15% for ONT, hence wl ~ L/13 plus slack.
+    Under-banding is safe (escape failure -> native fallback), just
+    wasted device work. |lt - lq| rides on top.
+    """
+    return _round_up(abs(lt - lq) + 2 * (max(lq, lt) // 13 + 64) + 1, 128)
+
+
+@functools.partial(
+    __import__("jax").jit,
+    static_argnames=("match", "mismatch", "gap", "W", "w_len", "NW", "Lq",
+                     "LA", "pallas"))
+def _chunk_breaking_points(q, t, lq, lt, t_begin, *, match, mismatch, gap,
+                           W, w_len, NW, Lq, LA, pallas):
+    """One device chunk: banded forward + column walk + per-window
+    first/last-match reduction.
+
+    Returns (first_c, qi_f, last_c, qi_l  — all int32[B, NW], column/
+    query indices RELATIVE to each lane's slice —, valid bool[B, NW],
+    fail f32[B] nonzero where the lane needs the native fallback).
+    """
+    import jax
+    import jax.numpy as jnp
+    from racon_tpu.ops.colwalk import col_walk
+    from racon_tpu.ops.pallas.band_kernel import (
+        fw_dirs_band, fw_dirs_band_xla, band_geometry)
+
+    B = q.shape[0]
+    klo, wl = band_geometry(lq, lt, W)
+    PW = W + Lq
+    # Pre-shifted per-lane target window: tband[b, y] = t[b, klo_b + y].
+    tpad = jnp.concatenate(
+        [jnp.zeros((B, PW), jnp.uint8), t,
+         jnp.zeros((B, PW), jnp.uint8)], axis=1)
+    y = jnp.arange(PW, dtype=jnp.int32)[None, :]
+    rel = klo[:, None] + y
+    okb = (rel >= 0) & (rel < lt[:, None])
+    sl = jax.vmap(
+        lambda row, s: jax.lax.dynamic_slice(row, (s,), (PW,)))(
+        tpad, klo + PW)
+    tband = jnp.where(okb, sl, 7).astype(jnp.uint8)
+
+    if pallas:
+        tb, ch = _pick_tiles(W, Lq)
+        dirs, hlast = fw_dirs_band(
+            tband, q.T, klo, lq, match=match, mismatch=mismatch, gap=gap,
+            W=W, tb=tb, ch=ch)
+    else:
+        dirs, hlast = fw_dirs_band_xla(
+            tband, q.T, klo, lq, match=match, mismatch=mismatch, gap=gap,
+            W=W)
+    cols = col_walk(dirs, lq, lt, klo, jnp.zeros(B, jnp.int32), LA=LA,
+                    layout="band_t" if pallas else "band")
+
+    # Tightened escape bound (same derivation as device_poa._round_core).
+    xend = jnp.clip(lt - lq - klo, 0, W - 1)
+    score = jnp.take_along_axis(hlast, xend[:, None], axis=1)[:, 0]
+    bound = (jnp.maximum(match, 0) * (jnp.minimum(lq, lt) - wl - 1) +
+             gap * (jnp.abs(lt - lq) + 2 * wl + 2))
+    fail = ((score < bound) | (wl < 16)).astype(jnp.float32) + \
+        cols["sat"].astype(jnp.float32)
+
+    # Consumer op / query index per target column c (walk step c + 1).
+    op = cols["op_c"][:, 1:LA + 1].astype(jnp.int32)     # [B, LA]
+    qi = cols["qi_c"][:, 1:LA + 1].astype(jnp.int32)
+    c = jnp.arange(LA, dtype=jnp.int32)[None, :]
+    is_m = (c < lt[:, None]) & (op == DIAG)
+    # Window of column c (absolute target coordinate), relative to the
+    # lane's first touched window.
+    widx = (t_begin[:, None] + c) // w_len - (t_begin // w_len)[:, None]
+    HUGE = 2 ** 30
+    firsts, lasts, valids = [], [], []
+    for k in range(NW):
+        mask = is_m & (widx == k)
+        firsts.append(jnp.min(jnp.where(mask, c, HUGE), axis=1))
+        lasts.append(jnp.max(jnp.where(mask, c, -1), axis=1))
+        valids.append(jnp.any(mask, axis=1))
+    first_c = jnp.stack(firsts, axis=1)                  # [B, NW]
+    last_c = jnp.stack(lasts, axis=1)
+    valid = jnp.stack(valids, axis=1)
+    qi_f = jnp.take_along_axis(qi, jnp.clip(first_c, 0, LA - 1), axis=1)
+    qi_l = jnp.take_along_axis(qi, jnp.clip(last_c, 0, LA - 1), axis=1)
+    return first_c, qi_f, last_c, qi_l, valid, fail
+
+
+def device_breaking_points(pending, sequences, window_length: int, *,
+                           match: int, mismatch: int, gap: int,
+                           log=None) -> List:
+    """Compute breaking points on device for as many overlaps as the
+    budget admits; returns the overlaps that still need the native path
+    (too long, escape-bound failure, or walk saturation).
+
+    Sets ``o.breaking_points`` (int64[N, 4], reference row format) on
+    every handled overlap — ``find_breaking_points`` then no-ops.
+    """
+    import jax
+    from racon_tpu.ops.encode import encode_bases
+
+    jobs = []      # (overlap, q_codes, t_codes, q_start)
+    fallback = []
+    for o in pending:
+        qb, tb = o.alignment_operands(sequences)
+        lq, lt = len(qb), len(tb)
+        if lq < 1 or lt < 1:
+            fallback.append(o)
+            continue
+        W = _round_up(band_width_for_read(lq, lt), 512)
+        lqp = _round_up(lq, 2048)
+        if (TB * lqp * W > MAX_DIR_ELEMS or
+                _vmem_est(W, lqp, 8) > _VMEM_BUDGET or
+                max(lq, lt) >= 2 ** 14):   # int16 walk emissions
+            fallback.append(o)
+            continue
+        q_start = o.q_begin if not o.strand else o.q_length - o.q_end
+        jobs.append((o, encode_bases(bytes(qb)), encode_bases(bytes(tb)),
+                     q_start))
+    if not jobs:
+        # A fully-rejected set must still say so — this exact condition
+        # once hid the genome workload falling back wholesale.
+        if log is not None and fallback:
+            print(f"[racon_tpu::Polisher::initialize] all {len(pending)} "
+                  "overlap alignments exceed the device length budget; "
+                  "using the native path", file=log)
+        return fallback
+
+    pallas = jax.default_backend() in ("tpu", "axon")
+    # RUN-level shape buckets: every distinct (Lq, LA, W) triple is a
+    # fresh executable, and a compile through this environment's remote
+    # AOT helper costs 1-2 MINUTES — per-chunk shape maxima turned the
+    # 2 Mb genome run's alignment phase into compile churn (503 s for
+    # ~20 s of device work, round-5 measurement). Jobs sort by length
+    # and buckets grow greedily under the running-maxima budget (padded
+    # Lq from one job combined with the band width of another can
+    # overflow the int32 flat-index budget even when each job fits
+    # alone), so a uniform read set compiles exactly once; each bucket
+    # then executes in TB-lane chunks.
+    jobs.sort(key=lambda j: (len(j[1]), len(j[2])))
+    buckets = []
+    cur: List = []
+    Lq = LA = W = 1
+    for j in jobs:
+        _, qc, tc, _ = j
+        tLq = max(Lq, _round_up(len(qc), 2048))
+        tLA = max(LA, _round_up(len(tc), 2048))
+        tW = max(W, _round_up(band_width_for_read(len(qc), len(tc)), 512))
+        if cur and (TB * tLq * tW > MAX_DIR_ELEMS or
+                    _vmem_est(tW, tLq, 8) > _VMEM_BUDGET):
+            buckets.append((cur, Lq, LA, W))
+            cur = []
+            tLq = _round_up(len(qc), 2048)
+            tLA = _round_up(len(tc), 2048)
+            tW = _round_up(band_width_for_read(len(qc), len(tc)), 512)
+        Lq, LA, W = tLq, tLA, tW
+        cur.append(j)
+    if cur:
+        buckets.append((cur, Lq, LA, W))
+
+    # Dispatch every chunk before collecting any: jit calls are async,
+    # so chunk i+1's h2d overlaps chunk i's compute (the tunnel's h2d
+    # otherwise serializes with device time).
+    import os
+    import sys as _sys
+    import time as _time
+    verbose = os.environ.get("RACON_TPU_TIMING", "") not in ("", "0")
+    t_disp = _time.perf_counter()
+    pending_out = []
+    for bucket, Lq, LA, W in buckets:
+        NW = LA // window_length + 2
+        B = TB
+        for s in range(0, len(bucket), B):
+            sub = bucket[s:s + B]
+            q = np.zeros((B, Lq), np.uint8)
+            t = np.zeros((B, LA), np.uint8)
+            lq = np.ones(B, np.int32)
+            lt = np.ones(B, np.int32)
+            t_begin = np.zeros(B, np.int32)
+            for b, (o, qc, tc, _) in enumerate(sub):
+                q[b, :len(qc)] = qc
+                t[b, :len(tc)] = tc
+                lq[b] = len(qc)
+                lt[b] = len(tc)
+                t_begin[b] = o.t_begin
+            pending_out.append((sub, _chunk_breaking_points(
+                q, t, lq, lt, t_begin, match=match, mismatch=mismatch,
+                gap=gap, W=W, w_len=window_length, NW=NW, Lq=Lq, LA=LA,
+                pallas=pallas)))
+
+    if verbose:
+        print(f"[racon_tpu::ovl_align] dispatch {len(pending_out)} "
+              f"chunks ({len(buckets)} shape buckets): "
+              f"{_time.perf_counter() - t_disp:.2f}s", file=_sys.stderr)
+        t_disp = _time.perf_counter()
+    for sub, out in pending_out:
+        first_c, qi_f, last_c, qi_l, valid, fail = map(np.asarray, out)
+        for b, (o, _, _, q_start) in enumerate(sub):
+            if fail[b]:
+                fallback.append(o)
+                continue
+            v = valid[b]
+            rows = np.stack([
+                o.t_begin + first_c[b][v],
+                q_start + qi_f[b][v],
+                o.t_begin + last_c[b][v] + 1,
+                q_start + qi_l[b][v] + 1,
+            ], axis=1).astype(np.int64)
+            o.breaking_points = rows
+    if verbose:
+        print(f"[racon_tpu::ovl_align] collect: "
+              f"{_time.perf_counter() - t_disp:.2f}s", file=_sys.stderr)
+    if log is not None and fallback:
+        n_budget = len(pending) - len(jobs)
+        print(f"[racon_tpu::Polisher::initialize] {len(fallback)} of "
+              f"{len(pending)} overlap alignments fall back to the "
+              f"native path ({n_budget} over the device length budget, "
+              f"{len(fallback) - n_budget} uncertified)", file=log)
+    return fallback

@@ -79,8 +79,8 @@ def _score_dtype(match: int, mismatch: int, gap: int, Lq: int, W: int):
 
 
 def _kernel(tbandT_ref, qT_ref, klo_ref, lq_ref, dirs_ref, hlast_ref,
-            prev_ref, uprev_ref, cprev_ref, *, match, mismatch, gap, W,
-            dtype):
+            prev_ref, ucprev_ref, *, match, mismatch, gap, W,
+            dtype, TB, CH):
     # Transposed layout: band slots x on SUBLANES, jobs on LANES. The
     # per-row moving target window is then a dynamic *sublane* slice
     # (supported by Mosaic at any offset), where the lane-major variant
@@ -102,13 +102,17 @@ def _kernel(tbandT_ref, qT_ref, klo_ref, lq_ref, dirs_ref, hlast_ref,
         # UP-chain metadata boundary (row 0): no UP can start above row 1,
         # and a chain that reaches row 0 is consumed by the forced LEFT
         # walk along the top row — encode that as consumer dir LEFT.
-        uprev_ref[:] = jnp.zeros((W, TB), jnp.int32)
-        cprev_ref[:] = jnp.full((W, TB), LEFT, jnp.int32)
+        # U and C share one packed scratch (U << 2 | C): a long-read
+        # overlap chunk's VMEM budget is tight (ovl_align), and a
+        # separate C buffer costs another (W, TB) i32 block.
+        ucprev_ref[:] = jnp.full((W, TB), LEFT, jnp.int32)
 
     def row(r, _):
         i = c * CH + r + 1                 # 1-based global row
         qrow = qT_ref[r]                   # [TB] int32
-        tw = tbandT_ref[pl.dslice(i - 1, W), :]           # [W, TB] int32
+        # (int32 tband: Mosaic requires 8-aligned dynamic sublane
+        # slices for narrower dtypes, and i - 1 is arbitrary.)
+        tw = tbandT_ref[pl.dslice(i - 1, W), :]
         jcol = i + klo[None, :] + xr       # absolute target column j
         sub = jnp.where(tw == qrow[None, :], match, mismatch)
         sub = jnp.where(jcol >= 1, sub, NEG).astype(dtype)
@@ -151,15 +155,13 @@ def _kernel(tbandT_ref, qT_ref, klo_ref, lq_ref, dirs_ref, hlast_ref,
         # lanes are re-polished on the host path), C carries the chain
         # top's consumer direction down the chain.
         isup = d == UP
-        uup = jnp.concatenate(
-            [uprev_ref[1:, :], jnp.zeros((1, TB), jnp.int32)], axis=0)
-        cup = jnp.concatenate(
-            [cprev_ref[1:, :], jnp.full((1, TB), LEFT, jnp.int32)], axis=0)
-        U = jnp.where(isup, jnp.minimum(uup + 1, U_SAT), 0)
-        C = jnp.where(isup, cup, d)
+        ucup = jnp.concatenate(
+            [ucprev_ref[1:, :], jnp.full((1, TB), LEFT, jnp.int32)],
+            axis=0)
+        U = jnp.where(isup, jnp.minimum((ucup >> 2) + 1, U_SAT), 0)
+        C = jnp.where(isup, ucup & 3, d)
         dirs_ref[r] = (d + (C << 2) + (U << 4)).astype(jnp.uint8)
-        uprev_ref[:] = U
-        cprev_ref[:] = C
+        ucprev_ref[:] = (U << 2) + C
         prev_ref[:] = h
         # Capture each lane's true final row as the row counter passes it.
         hlast_ref[:] = jnp.where((lqv == i)[None, :], h, hlast_ref[:])
@@ -169,10 +171,11 @@ def _kernel(tbandT_ref, qT_ref, klo_ref, lq_ref, dirs_ref, hlast_ref,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("match", "mismatch", "gap", "W"))
+                   static_argnames=("match", "mismatch", "gap", "W",
+                                    "tb", "ch"))
 def fw_dirs_band(tband: jnp.ndarray, qT: jnp.ndarray, klo: jnp.ndarray,
                  lq: jnp.ndarray, *, match: int, mismatch: int, gap: int,
-                 W: int):
+                 W: int, tb: int = TB, ch: int = CH):
     """Banded packed-cell tensor + final-row scores (Pallas, transposed).
 
     Args:
@@ -186,40 +189,43 @@ def fw_dirs_band(tband: jnp.ndarray, qT: jnp.ndarray, klo: jnp.ndarray,
     ``transposed=True`` for it. hlast[b, x] = H[lq_b][lq_b + klo_b + x].
     Each cell byte packs ``dir | consumer_dir << 2 | up_run << 4`` (see
     racon_tpu/ops/colwalk.py for the traceback that consumes it; the
-    plain direction is the low 2 bits). B % 128 == 0, Lq % 32 == 0,
-    W % 128 == 0 required.
+    plain direction is the low 2 bits). B % tb == 0, Lq % ch == 0
+    required. ``tb``/``ch`` tile the lane/row grid: the defaults suit
+    consensus-window shapes; long-read overlap alignment (W in the
+    thousands, racon_tpu/ops/ovl_align.py) passes smaller tiles so the
+    per-lane (W + Lq) target window plus scratch stays inside the
+    ~16 MiB VMEM budget (tb=128 at W=2176/Lq=5632 overflows by ~4 MiB).
     """
     B = tband.shape[0]
     Lq = qT.shape[0]
     dtype = _score_dtype(match, mismatch, gap, Lq, W)
     kernel = functools.partial(_kernel, match=match, mismatch=mismatch,
-                               gap=gap, W=W, dtype=dtype)
+                               gap=gap, W=W, dtype=dtype, TB=tb, CH=ch)
     dirs, hlast = pl.pallas_call(
         kernel,
-        grid=(B // TB, Lq // CH),
+        grid=(B // tb, Lq // ch),
         in_specs=[
-            pl.BlockSpec((W + Lq, TB), lambda b, c: (0, b),
+            pl.BlockSpec((W + Lq, tb), lambda b, c: (0, b),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((CH, TB), lambda b, c: (c, b),
+            pl.BlockSpec((ch, tb), lambda b, c: (c, b),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, TB), lambda b, c: (0, b),
+            pl.BlockSpec((1, tb), lambda b, c: (0, b),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, TB), lambda b, c: (0, b),
+            pl.BlockSpec((1, tb), lambda b, c: (0, b),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=[
-            pl.BlockSpec((CH, W, TB), lambda b, c: (c, 0, b),
+            pl.BlockSpec((ch, W, tb), lambda b, c: (c, 0, b),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((W, TB), lambda b, c: (0, b),
+            pl.BlockSpec((W, tb), lambda b, c: (0, b),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((Lq, W, B), jnp.uint8),
             jax.ShapeDtypeStruct((W, B), dtype),
         ],
-        scratch_shapes=[pltpu.VMEM((W, TB), dtype),
-                        pltpu.VMEM((W, TB), jnp.int32),
-                        pltpu.VMEM((W, TB), jnp.int32)],
+        scratch_shapes=[pltpu.VMEM((W, tb), dtype),
+                        pltpu.VMEM((W, tb), jnp.int32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")),
     )(tband.astype(jnp.int32).T, qT.astype(jnp.int32),

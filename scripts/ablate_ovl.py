@@ -1,0 +1,111 @@
+"""Ablation: where a device overlap-alignment chunk spends its time.
+
+Builds one 128-lane chunk of ~8 kb synthetic overlap jobs (the genome
+bench geometry) and times jitted prefixes: tband build, banded forward,
+column walk, breaking-point reduction.
+"""
+
+import os
+import sys
+import time
+import functools
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def t(fn, *args, reps=3, **kw):
+    out = np.asarray(fn(*args, **kw))
+    t0 = time.perf_counter()
+    o = None
+    for _ in range(reps):
+        o = fn(*args, **kw)
+    np.asarray(o)
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from racon_tpu.ops.ovl_align import (band_width_for_read, _round_up,
+                                         _pick_tiles)
+    from racon_tpu.ops.colwalk import col_walk
+    from racon_tpu.ops.pallas.band_kernel import (
+        fw_dirs_band, fw_dirs_band_xla, band_geometry)
+    from racon_tpu.ops.cigar import DIAG
+
+    B = 128
+    rng = np.random.default_rng(0)
+    L = 8000
+    Lq = _round_up(L + 400, 2048)
+    LA = Lq
+    W = _round_up(band_width_for_read(L, L), 512)
+    w_len = 500
+    NW = LA // w_len + 2
+    pallas = jax.default_backend() in ("tpu", "axon")
+    tb, ch = _pick_tiles(W, Lq)
+    print(f"backend={jax.default_backend()} B={B} Lq={Lq} W={W} NW={NW} "
+          f"tiles={tb},{ch}")
+
+    q = rng.integers(0, 4, (B, Lq)).astype(np.uint8)
+    tt = rng.integers(0, 4, (B, LA)).astype(np.uint8)
+    lq = np.full(B, L, np.int32)
+    lt = np.full(B, L + 37, np.int32)
+    t_begin = rng.integers(0, 10000, B).astype(np.int32)
+
+    @functools.partial(jax.jit, static_argnames=("upto",))
+    def stage(q, tt, lq, lt, t_begin, *, upto):
+        klo, wl = band_geometry(lq, lt, W)
+        PW = W + Lq
+        tpad = jnp.concatenate(
+            [jnp.zeros((B, PW), jnp.uint8), tt,
+             jnp.zeros((B, PW), jnp.uint8)], axis=1)
+        y = jnp.arange(PW, dtype=jnp.int32)[None, :]
+        rel = klo[:, None] + y
+        okb = (rel >= 0) & (rel < lt[:, None])
+        sl = jax.vmap(
+            lambda row, s: jax.lax.dynamic_slice(row, (s,), (PW,)))(
+            tpad, klo + PW)
+        tband = jnp.where(okb, sl, 7).astype(jnp.uint8)
+        if upto == "tband":
+            return jnp.sum(tband[:, ::64].astype(jnp.int32))
+        if pallas:
+            dirs, hlast = fw_dirs_band(
+                tband, q.T, klo, lq, match=0, mismatch=-1, gap=-1,
+                W=W, tb=tb, ch=ch)
+        else:
+            dirs, hlast = fw_dirs_band_xla(
+                tband, q.T, klo, lq, match=0, mismatch=-1, gap=-1, W=W)
+        if upto == "fw":
+            return jnp.sum(dirs[0, 0].astype(jnp.int32)) + jnp.sum(hlast)
+        cols = col_walk(dirs, lq, lt, klo, jnp.zeros(B, jnp.int32),
+                        LA=LA, layout="band_t" if pallas else "band")
+        if upto == "walk":
+            return sum(jnp.sum(cols[k].astype(jnp.int32))
+                       for k in ("ins_len", "op_c", "qi_c"))
+        op = cols["op_c"][:, 1:LA + 1].astype(jnp.int32)
+        qi = cols["qi_c"][:, 1:LA + 1].astype(jnp.int32)
+        c = jnp.arange(LA, dtype=jnp.int32)[None, :]
+        is_m = (c < lt[:, None]) & (op == DIAG)
+        widx = (t_begin[:, None] + c) // w_len - \
+            (t_begin // w_len)[:, None]
+        HUGE = 2 ** 30
+        outs = []
+        for k in range(NW):
+            mask = is_m & (widx == k)
+            outs.append(jnp.min(jnp.where(mask, c, HUGE), axis=1))
+            outs.append(jnp.max(jnp.where(mask, c, -1), axis=1))
+        fc = jnp.stack(outs[::2], axis=1)
+        return jnp.sum(fc) + jnp.sum(qi[:, ::64])
+
+    args = (q, tt, lq, lt, t_begin)
+    prev = 0.0
+    for upto in ("tband", "fw", "walk", "bp"):
+        dt = t(stage, *args, upto=upto)
+        print(f"{upto:6s}: {dt:.3f}s (+{dt - prev:.3f}s)", flush=True)
+        prev = dt
+
+
+if __name__ == "__main__":
+    main()

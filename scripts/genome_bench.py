@@ -52,6 +52,8 @@ RC[np.frombuffer(b"ACGT", np.uint8)] = np.frombuffer(b"TGCA", np.uint8)
 
 
 def main():
+    # The CLI subprocess enables the persistent compile cache itself;
+    # repeated genome runs then skip the 1-2 min/shape remote compiles.
     args = [a for a in sys.argv[1:] if not a.startswith("--")]
     genome_mb = float(args[0]) if args else 5.0
     coverage = int(args[1]) if len(args) > 1 else 30

@@ -1,0 +1,71 @@
+"""Held-out validation for ins_scale settings chosen on the 4 primary
+lambda configs: w=1000 golden, (1,-1,-1)-scoring golden, and the
+fragment-correction totals. Guards against fitting the acceptance set.
+
+Usage: python scripts/quality_holdout.py 0.2:0.6 0.15:0.6
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from quality_sweep import edit_distance  # noqa: E402
+
+
+def main():
+    from racon_tpu.models.polisher import create_polisher, PolisherType
+    from racon_tpu.ops.encode import reverse_complement
+    from racon_tpu.io.parsers import FastaParser
+
+    D = "/root/reference/test/data/"
+    ref = FastaParser(D + "sample_reference.fasta.gz").parse_all()[0].data
+
+    def mk(reads, ovl, type_=PolisherType.kC, window=500,
+           scores=(5, -4, -8), base=None, final=None, target=None):
+        p = create_polisher(D + reads, D + ovl,
+                            D + (target or "sample_layout.fasta.gz"),
+                            type_, window, 10, 0.3, *scores,
+                            backend="jax")
+        if base is not None:
+            p.engine.ins_scale = base
+            p.engine.ins_scale_final = final
+        p.initialize()
+        return p.polish(type_ == PolisherType.kC)
+
+    for a in sys.argv[1:]:
+        parts = a.split(":")
+        base = float(parts[0])
+        final = float(parts[1]) if len(parts) > 1 else None
+        print(f"--- ins_scale={base} final={final}", flush=True)
+
+        out = mk("sample_reads.fastq.gz", "sample_overlaps.paf.gz",
+                 window=1000, base=base, final=final)
+        ed = edit_distance(reverse_complement(out[0].data), ref)
+        print(f"  w=1000: ED {ed} (golden 1289)", flush=True)
+
+        out = mk("sample_reads.fastq.gz", "sample_overlaps.paf.gz",
+                 scores=(1, -1, -1), base=base, final=final)
+        ed = edit_distance(reverse_complement(out[0].data), ref)
+        print(f"  scores(1,-1,-1): ED {ed} (golden 1321)", flush=True)
+
+        out = mk("sample_reads.fastq.gz", "sample_ava_overlaps.paf.gz",
+                 scores=(1, -1, -1), base=base, final=final,
+                 target="sample_reads.fastq.gz")
+        total = sum(len(s.data) for s in out)
+        print(f"  kC-ava: {len(out)} seqs / {total} bp "
+              f"(golden 39 / 389,394; ratio {total / 389394:.4f})",
+              flush=True)
+
+        out = mk("sample_reads.fastq.gz", "sample_ava_overlaps.paf.gz",
+                 type_=PolisherType.kF, scores=(1, -1, -1), base=base,
+                 final=final, target="sample_reads.fastq.gz")
+        out = [s for s in out]
+        total = sum(len(s.data) for s in out)
+        print(f"  kF-ava: {len(out)} seqs / {total} bp "
+              f"(golden 236 / 1,658,216; ratio {total / 1658216:.4f})",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

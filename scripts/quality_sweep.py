@@ -1,0 +1,82 @@
+"""Quality experiment harness: lambda golden EDs vs engine knobs.
+
+Runs the four reference acceptance configs (PAF/SAM x FASTQ/FASTA)
+through the full polisher on the current backend and prints the edit
+distance vs NC_001416 for each, for every knob combination given.
+
+Usage:
+  python scripts/quality_sweep.py                  # current defaults
+  python scripts/quality_sweep.py 0.3:1.0 0.25:0.6 # ins_scale:final
+Each arg is base[:final] — one setting for both weight regimes, so the
+sweep tests derivation hypotheses, not per-regime fitting.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+GOLD = {
+    ("sample_reads.fastq.gz", "sample_overlaps.paf.gz"): 1312,
+    ("sample_reads.fasta.gz", "sample_overlaps.paf.gz"): 1566,
+    ("sample_reads.fastq.gz", "sample_overlaps.sam.gz"): 1317,
+    ("sample_reads.fasta.gz", "sample_overlaps.sam.gz"): 1770,
+}
+
+
+def edit_distance(a, b):
+    from racon_tpu.native.aligner import NativeAligner
+    from racon_tpu.ops.encode import encode_bases
+    ops = NativeAligner().align(a, b)
+    qa, ta = encode_bases(a), encode_bases(b)
+    qi = ti = ed = 0
+    for d in ops:
+        if d == 0:
+            ed += int(qa[qi] != ta[ti])
+            qi += 1
+            ti += 1
+        else:
+            ed += 1
+            qi += d == 1
+            ti += d == 2
+    return ed
+
+
+def main():
+    from racon_tpu.models.polisher import create_polisher, PolisherType
+    from racon_tpu.ops.encode import reverse_complement
+    from racon_tpu.io.parsers import FastaParser
+
+    D = "/root/reference/test/data/"
+    ref = FastaParser(D + "sample_reference.fasta.gz").parse_all()[0].data
+
+    combos = []
+    for a in sys.argv[1:]:
+        parts = a.split(":")
+        combos.append((float(parts[0]),
+                       float(parts[1]) if len(parts) > 1 else None))
+    if not combos:
+        combos = [(None, None)]
+
+    for base, final in combos:
+        print(f"--- ins_scale={base} final={final}", flush=True)
+        for (reads, ovl), gold in GOLD.items():
+            p = create_polisher(D + reads, D + ovl,
+                                D + "sample_layout.fasta.gz",
+                                PolisherType.kC, 500, 10, 0.3, 5, -4, -8,
+                                backend="jax")
+            if base is not None:
+                p.engine.ins_scale = base
+                p.engine.ins_scale_final = final
+            p.initialize()
+            out = p.polish(True)
+            ed = edit_distance(reverse_complement(out[0].data), ref)
+            tag = "FASTQ" if "fastq" in reads else "FASTA"
+            o = "PAF" if "paf" in ovl else "SAM"
+            print(f"  {o}+{tag}: ED {ed} (golden {gold}, "
+                  f"{'BEAT' if ed <= gold else f'+{ed - gold}'})",
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()
