@@ -119,16 +119,24 @@ def main():
     assert n_changed > n_windows * 0.9, "consensus did not polish"
 
     e2e = n_windows / dt
-    # Compute-only: time one warm production chunk (all refinement
-    # rounds, one dispatch) with chained reps and a single trailing
-    # sync. The earlier stats-serialized phase split paid a ~75 ms
-    # tunnel round-trip per phase edge and let in-flight transfers bleed
-    # between phases — through this tunnel its numbers were noise.
+    # Compute-only: time one warm production chunk with chained reps.
+    # When the convergence scheduler is on (the default), the production
+    # chunk program IS the scheduler's dispatch chain (racon_tpu/sched/)
+    # — its per-chunk flag pulls are on the critical path, so each rep
+    # syncs; the fixed engine's single all-rounds dispatch rides along
+    # in extras for round-over-round continuity (and is the primary
+    # value under RACON_TPU_SCHED=0). The earlier stats-serialized phase
+    # split paid a ~75 ms tunnel round-trip per phase edge and let
+    # in-flight transfers bleed between phases — through this tunnel its
+    # numbers were noise.
     compute = e2e
+    sched_extras = {}
     if backend == "jax":
         from racon_tpu.ops.device_poa import (ChunkPlan, run_caps,
                                               _use_pallas,
                                               device_chunk_packed)
+        from racon_tpu.sched import (ConvergenceScheduler, SchedTelemetry,
+                                     sched_enabled)
         n_sub = min(n_windows, 128)
         sub = build_windows(n_sub, coverage, wlen, seed=3)
         lqm = max(max(len(d) for d in w.layer_data) for w in sub)
@@ -150,14 +158,39 @@ def main():
         for _ in range(reps):
             out = device_chunk_packed(job_buf, win_buf, **kw)
         np.asarray(out[:1])
-        compute = n_sub / ((time.perf_counter() - t1) / reps)
+        fixed_rate = n_sub / ((time.perf_counter() - t1) / reps)
+        compute = fixed_rate
+        if sched_enabled():
+            sched = ConvergenceScheduler(
+                match=5, mismatch=-4, gap=-8,
+                scales=eng._round_scales(eng.refine_rounds + 1))
+            sched.run_chunk(plan, bufs=(job_buf, win_buf))  # compile/warm
+            sched.telemetry = SchedTelemetry(sched.rounds)  # timed-only
+            t1 = time.perf_counter()
+            for _ in range(reps):
+                sched.run_chunk(plan, bufs=(job_buf, win_buf))
+            compute = n_sub / ((time.perf_counter() - t1) / reps)
+            sched_extras = sched.telemetry.as_extras()
+            sched_extras["fixed_engine_windows_per_sec"] = \
+                round(fixed_rate, 2)
     # Chunk pipelining overlaps h2d/compute/d2h, so pipelined end-to-end
     # reflects the tunnel-fed rate while compute-only is the chip rate;
     # both are reported.
     print(json.dumps({
+        # metric_version 2: "value" is compute-only windows/s of a warm
+        # production chunk (the convergence scheduler's dispatch chain
+        # when RACON_TPU_SCHED is on — the default — else the fixed
+        # fused dispatch); e2e_* extras carry the tunnel-fed pipelined
+        # rate. Version 1 (rounds <= 5) timed the fixed fused dispatch
+        # only — that series continues under
+        # fixed_engine_windows_per_sec. Bump this whenever the primary
+        # value's definition changes, so round-over-round comparisons
+        # can't silently mix metrics.
+        "metric_version": 2,
         "metric": f"POA windows/sec/chip, compute-only (direct-timed warm "
-                  f"production chunk, all refinement rounds in one "
-                  f"dispatch; w={wlen}, {coverage}x cov, "
+                  f"production chunk, convergence-scheduled refinement "
+                  f"rounds — racon_tpu/sched/, telemetry in sched_* "
+                  f"extras; w={wlen}, {coverage}x cov, "
                   f"backend={backend}:{dev}; vs_baseline = value / "
                   "MEASURED 64-thread-idealized native CPU anchor "
                   f"{CPU_64T_WINDOWS_PER_SEC:.1f} w/s; chunk-pipelined "
@@ -177,6 +210,7 @@ def main():
         "cpu_anchor_1t_measured": CPU_1T_MEASURED,
         "vs_ref_spoa_64t_est": round(compute / CPU_64T_REF_SPOA_EST, 3),
         "n_windows": n_windows,
+        **sched_extras,
     }))
 
 

@@ -26,6 +26,9 @@ else
   echo "[ci] device-engine golden (SAM+FASTQ acceptance, gates ED <= 1317)"
   python -m pytest tests/test_polisher.py -q -m '' \
     -k test_consensus_device_engine_golden_sam_fastq
+  echo "[ci] scheduler differential golden (sched vs fixed, SAM+FASTQ)"
+  python -m pytest tests/test_polisher.py -q -m '' \
+    -k "test_sched_differential_golden and sam_fastq"
 fi
 
 echo "[ci] multi-chip dryrun (8 virtual devices)"

@@ -82,9 +82,6 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv: Optional[List[str]] = None) -> int:
     ap = build_parser()
     args = ap.parse_args(argv)
-    # After parse_args: --help/usage errors should not pay a jax import.
-    from racon_tpu.utils.jaxcache import enable_compile_cache
-    enable_compile_cache()
 
     if args.version:
         print(f"v{__version__}")
@@ -96,6 +93,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("[racon_tpu::] error: missing input file(s)!", file=sys.stderr)
         ap.print_help(sys.stderr)
         return 1
+    # Below every early return: --version/--help/usage errors should not
+    # pay the jax import the cache setup triggers.
+    from racon_tpu.utils.jaxcache import enable_compile_cache
+    enable_compile_cache()
 
     from racon_tpu.models.overlap import PolisherError
     from racon_tpu.io.parsers import ParseError

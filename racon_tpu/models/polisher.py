@@ -339,6 +339,9 @@ class Polisher:
         for s in range(0, n_windows, self.window_chunk):
             self.engine.consensus_windows(self.windows[s:s + self.window_chunk])
             log.tick("[racon_tpu::Polisher::polish] generating consensus")
+        telem = getattr(self.engine, "sched_telemetry", None)
+        if telem is not None and telem.windows:
+            log.sched_summary(telem)
 
         dst: List[PolishedSequence] = []
         polished_data: List[bytes] = []

@@ -52,9 +52,17 @@ from racon_tpu.ops.poa import _EPS as EPS  # shared tie-break epsilon
 K_INS = 10
 # The contract above only holds when the walk's saturation threshold
 # tracks K (and extract_votes_cols' packed-word layout is hand-laid for
-# K = 10); fail loudly if either is retuned alone.
-assert _U_SAT == K_INS + 1, "flat.U_SAT must equal K_INS + 1"
-assert K_INS == 10, "extract_votes_cols' word layout is built for K=10"
+# K = 10); fail loudly at import if either is retuned alone. ValueError,
+# not assert: asserts are stripped under `python -O`, and silently
+# running with a mismatched layout corrupts every consensus.
+if _U_SAT != K_INS + 1:
+    raise ValueError(
+        "[racon_tpu::device_merge] flat.U_SAT must equal K_INS + 1 "
+        f"(U_SAT={_U_SAT}, K_INS={K_INS})")
+if K_INS != 10:
+    raise ValueError(
+        "[racon_tpu::device_merge] extract_votes_cols' packed-word "
+        f"layout is hand-laid for K_INS=10 (got {K_INS})")
 NBASE = 5          # A C G T N
 # Python int, NOT jnp.int32: a module-level jax.Array closed over by a
 # jitted function lowers as a hoisted buffer parameter on some traces, and
@@ -469,6 +477,35 @@ def aggregate_votes(votes, win, n_win: int, extras=None):
         gap.shape[0], gap.shape[1], K_INS, NBASE); i += K_INS * NBASE
     out["lenw"] = gap[..., i:i + K_INS - 1]; i += K_INS - 1
     return out
+
+
+def aggregate_flags(flags, win, n_win: int):
+    """Per-window sums of one per-job scalar via the same membership
+    matmul aggregate_votes rides ([Nw, B] @ [B, 1] — one MXU pass, the
+    "cheap reduction appended to the merge step" of the convergence
+    scheduler). Exact for 0/1 flags (f32 sums far below 2^24)."""
+    M32 = (jnp.arange(n_win, dtype=jnp.int32)[:, None] ==
+           win[None, :]).astype(jnp.float32)
+    return jnp.matmul(M32, flags[:, None].astype(jnp.float32),
+                      precision=_PREC)[:, 0]
+
+
+def converged_windows(codes, total, bb_old, alen_old, wchg):
+    """Per-window fixed-point predicate for the convergence scheduler.
+
+    A window is converged when this round reproduced its own input
+    anchor exactly — same length, same code bytes (both arrays are
+    zero-padded past their lengths, so full-row equality composes with
+    the length check), and no lane span moved through the coordinate
+    maps (``wchg``: per-window sum of lane span-change flags; a
+    consensus can match byte-for-byte while deletions and insertions
+    offset each other and still shift spans, so byte equality alone is
+    NOT a fixed point). Only meaningful from round 1 on: the round-0
+    anchor carries backbone quality weights, later anchors re-vote with
+    neutral weights, so round 0's input is not a replayable state.
+    """
+    return (total == alen_old) & (wchg == 0) & \
+        jnp.all(codes == bb_old, axis=1)
 
 
 def add_backbone(acc, bb, bbw, alen):

@@ -322,21 +322,20 @@ def _use_pallas(B: int, Lq: int, LA: int) -> bool:
     return B % TB == 0 and Lq % CH == 0 and LA % 128 == 0
 
 
-def _round_core(bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf, *,
-                match, mismatch, gap, ins_scale, Lq, n_win,
-                LA, pallas, band_w=0, axis_name=None):
-    """One alignment + merge round (traced body, single shard's view).
+def _lane_votes(bb, alen, begin, end, q, qw8, lq, w_read, win, *,
+                match, mismatch, gap, Lq, LA, pallas, band_w=0):
+    """Job geometry + NW forward + column-walk + vote extraction for
+    every lane of one refinement round (traced body, one shard's view).
 
-    Returns (new_bb, new_bbw, new_alen, new_begin, new_end, cov, ovf).
-    ``ovf`` is a sticky per-window flag: consensus outgrew the padded
-    anchor width this round (or any earlier one) and was truncated —
-    the host must re-run those windows (the host path is unbounded).
+    The shared front half of a round: the fixed-round engine
+    (_round_core) and the convergence scheduler's detecting round
+    (racon_tpu/sched/rounds.py) both consume its output, so the two
+    dispatch paths run one implementation of the alignment contract.
 
-    Under shard_map the job (B) axis is sharded over ``axis_name`` while
-    window arrays are replicated; the only collective is one psum of the
-    per-window vote accumulators (jobs of one window may live on any
-    shard) — windows are otherwise independent, matching the reference's
-    per-window fan-out (src/polisher.cpp:457-469).
+    Returns (votes dict of per-job channels for dm.aggregate_votes,
+    esc_w f32[B] — positive where the banded walk's exactness
+    certificate failed and the lane's window must re-polish on the
+    unbounded host path).
     """
     import jax
     import jax.numpy as jnp
@@ -431,21 +430,21 @@ def _round_core(bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf, *,
     # same redo route as the band escape bound.
     sat_w = cols["sat"].astype(jnp.float32)
     esc_w = sat_w if esc_w is None else esc_w + sat_w
-    # The band-escape per-window sum rides aggregate_votes' membership
-    # matrix and the same single psum as the votes.
-    acc = dm.aggregate_votes(votes, win, n_win + 1, extras={"_esc": esc_w})
-    if axis_name is not None:
-        acc = {k: jax.lax.psum(v, axis_name) for k, v in acc.items()}
-    wesc = acc.pop("_esc", None)
-    acc = {k: v[:-1] for k, v in acc.items()}       # drop padded-lane row
-    acc = dm.add_backbone(acc, bb[:-1], bbw[:-1], alen[:-1])
-    asm = dm.assemble(acc, alen[:-1], ins_scale)
-    codes, cov, total = dm.compact(asm, LA)
-    map_b, map_e = dm.coord_maps(asm, alen[:-1], LA)
+    return votes, esc_w
 
-    # Next-round anchors (dummy row re-appended) and remapped spans.
+
+def _remap_state(codes, total, map_b, map_e, bb, alen, begin, end, win,
+                 LA: int):
+    """Next-round anchors (dummy row re-appended) and spans remapped
+    through the merge's coordinate maps — the shared back half of a
+    round's state update (``bb``/``alen``/``begin``/``end`` are the
+    round's INPUT state; returns the new anchor table, lengths, and
+    per-lane spans)."""
+    import jax
+    import jax.numpy as jnp
+
+    L = jnp.take(alen, win)                             # anchor len per job
     new_bb = jnp.concatenate([codes, bb[-1:]], axis=0)
-    new_bbw = jnp.zeros_like(bbw)
     new_alen = jnp.concatenate(
         [jnp.clip(total, 1, LA), alen[-1:]], axis=0).astype(jnp.int32)
     mb_flat = map_b.reshape(-1)
@@ -458,6 +457,48 @@ def _round_core(bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf, *,
     ne = jnp.where(end < L,
                    jnp.take(me_flat, winc * LA + jnp.clip(end, 0, LA - 1)),
                    tot_j - 1).astype(jnp.int32)
+    return new_bb, new_alen, nb, ne
+
+
+def _round_core(bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf, *,
+                match, mismatch, gap, ins_scale, Lq, n_win,
+                LA, pallas, band_w=0, axis_name=None):
+    """One alignment + merge round (traced body, single shard's view).
+
+    Returns (new_bb, new_bbw, new_alen, new_begin, new_end, cov, ovf).
+    ``ovf`` is a sticky per-window flag: consensus outgrew the padded
+    anchor width this round (or any earlier one) and was truncated —
+    the host must re-run those windows (the host path is unbounded).
+
+    Under shard_map the job (B) axis is sharded over ``axis_name`` while
+    window arrays are replicated; the only collective is one psum of the
+    per-window vote accumulators (jobs of one window may live on any
+    shard) — windows are otherwise independent, matching the reference's
+    per-window fan-out (src/polisher.cpp:457-469).
+    """
+    import jax
+    import jax.numpy as jnp
+    from racon_tpu.ops import device_merge as dm
+
+    votes, esc_w = _lane_votes(
+        bb, alen, begin, end, q, qw8, lq, w_read, win, match=match,
+        mismatch=mismatch, gap=gap, Lq=Lq, LA=LA, pallas=pallas,
+        band_w=band_w)
+    # The band-escape per-window sum rides aggregate_votes' membership
+    # matrix and the same single psum as the votes.
+    acc = dm.aggregate_votes(votes, win, n_win + 1, extras={"_esc": esc_w})
+    if axis_name is not None:
+        acc = {k: jax.lax.psum(v, axis_name) for k, v in acc.items()}
+    wesc = acc.pop("_esc", None)
+    acc = {k: v[:-1] for k, v in acc.items()}       # drop padded-lane row
+    acc = dm.add_backbone(acc, bb[:-1], bbw[:-1], alen[:-1])
+    asm = dm.assemble(acc, alen[:-1], ins_scale)
+    codes, cov, total = dm.compact(asm, LA)
+    map_b, map_e = dm.coord_maps(asm, alen[:-1], LA)
+
+    new_bb, new_alen, nb, ne = _remap_state(
+        codes, total, map_b, map_e, bb, alen, begin, end, win, LA)
+    new_bbw = jnp.zeros_like(bbw)
     ovf = ovf | (total > LA)
     if wesc is not None:
         ovf = ovf | (wesc[:-1] > 0)
@@ -468,6 +509,26 @@ device_round = functools.partial(
     __import__("jax").jit,
     static_argnames=("match", "mismatch", "gap", "ins_scale", "Lq",
                      "n_win", "LA", "pallas", "band_w"))(_round_core)
+
+
+def round_band_width(band_w: int, r: int) -> int:
+    """Band width for refinement round ``r`` of a chunk.
+
+    Round 0 aligns against the raw backbone and needs the full chunk
+    band; later rounds align against a near-converged consensus whose
+    spans were remapped through the previous merge, so the optimum hugs
+    the diagonal and a narrower band suffices — exactness is still
+    certified per lane per round by the escape bound, with failures
+    taking the host redo route. 192 (not 128): at wl ~= 95 the
+    tightened bound sits ~1000 below real noisy-read scores, where
+    W=128's wl ~= 63 made it marginal and re-routed 58/96 lambda
+    windows (round-5 measurement; Mosaic only needs W % 8, not % 128).
+
+    Shared by every dispatch path (device_chunk_packed, the
+    RACON_TPU_TIMING=1 per-round path, and the convergence scheduler)
+    so profiling and scheduling always execute the production program.
+    """
+    return band_w if (r == 0 or not band_w) else min(band_w, 192)
 
 
 def _make_round_fn(*, match, mismatch, gap, ins_scale, Lq, n_win, LA,
@@ -487,15 +548,46 @@ def _make_round_fn(*, match, mismatch, gap, ins_scale, Lq, n_win, LA,
         band_w=band_w, axis_name=None if mesh is None else "dp")
     if mesh is None:
         return core
-    import jax
     from jax.sharding import PartitionSpec as P
+    from racon_tpu.utils.jaxcompat import shard_map
     rep = P()
     job = P("dp")
-    return jax.shard_map(
+    return shard_map(
         core, mesh=mesh,
         in_specs=(rep, rep, rep, job, job, job, job, job, job, job, rep),
         out_specs=(rep, rep, rep, job, job, rep, rep),
         check_vma=False)
+
+
+def _unpack_bufs(job_buf, win_buf, Lq: int, LA: int):
+    """Slice ChunkPlan.packed_bufs()' concatenated byte layouts back into
+    round-state arrays (traced body). The layout contract lives here and
+    in packed_bufs, nowhere else.
+
+    Returns (q, qw8, begin, end, lq, win, w_read, bb, bbw, alen).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def i32(col):
+        return jax.lax.bitcast_convert_type(col, jnp.int32)
+
+    q = job_buf[:, :Lq]
+    qw8 = job_buf[:, Lq:2 * Lq]
+    sc = job_buf[:, 2 * Lq:]
+    B = job_buf.shape[0]
+    begin = i32(sc[:, 0:4].reshape(B, 1, 4))[:, 0]
+    end = i32(sc[:, 4:8].reshape(B, 1, 4))[:, 0]
+    lq = i32(sc[:, 8:12].reshape(B, 1, 4))[:, 0]
+    win = i32(sc[:, 12:16].reshape(B, 1, 4))[:, 0]
+    w_read = jax.lax.bitcast_convert_type(
+        sc[:, 16:20].reshape(B, 1, 4), jnp.float32)[:, 0]
+    Nw1 = win_buf.shape[0]
+    bb = win_buf[:, :LA]
+    bbw = jax.lax.bitcast_convert_type(
+        win_buf[:, LA:5 * LA].reshape(Nw1, LA, 4), jnp.float32)
+    alen = i32(win_buf[:, 5 * LA:].reshape(Nw1, 1, 4))[:, 0]
+    return q, qw8, begin, end, lq, win, w_read, bb, bbw, alen
 
 
 @functools.partial(
@@ -522,24 +614,8 @@ def device_chunk_packed(job_buf, win_buf, *, match, mismatch, gap,
     import jax
     import jax.numpy as jnp
 
-    def i32(col):
-        return jax.lax.bitcast_convert_type(col, jnp.int32)
-
-    q = job_buf[:, :Lq]
-    qw8 = job_buf[:, Lq:2 * Lq]
-    sc = job_buf[:, 2 * Lq:]
-    B = job_buf.shape[0]
-    begin = i32(sc[:, 0:4].reshape(B, 1, 4))[:, 0]
-    end = i32(sc[:, 4:8].reshape(B, 1, 4))[:, 0]
-    lq = i32(sc[:, 8:12].reshape(B, 1, 4))[:, 0]
-    win = i32(sc[:, 12:16].reshape(B, 1, 4))[:, 0]
-    w_read = jax.lax.bitcast_convert_type(
-        sc[:, 16:20].reshape(B, 1, 4), jnp.float32)[:, 0]
-    Nw1 = win_buf.shape[0]
-    bb = win_buf[:, :LA]
-    bbw = jax.lax.bitcast_convert_type(
-        win_buf[:, LA:5 * LA].reshape(Nw1, LA, 4), jnp.float32)
-    alen = i32(win_buf[:, 5 * LA:].reshape(Nw1, 1, 4))[:, 0]
+    (q, qw8, begin, end, lq, win, w_read, bb, bbw, alen) = \
+        _unpack_bufs(job_buf, win_buf, Lq, LA)
 
     ovf = jnp.zeros(n_win, dtype=bool)
     cov = None
@@ -554,17 +630,7 @@ def device_chunk_packed(job_buf, win_buf, *, match, mismatch, gap,
             mesh=mesh)
 
     for r in range(rounds):
-        # Round 0 aligns against the raw backbone and needs the full
-        # chunk band; later rounds align against a near-converged
-        # consensus whose spans were remapped through the previous
-        # merge, so the optimum hugs the diagonal and a narrower band
-        # suffices — exactness is still certified per lane per round by
-        # the escape bound, with failures taking the host redo route.
-        # 192 (not 128): at wl ~= 95 the tightened bound sits ~1000
-        # below real noisy-read scores, where W=128's wl ~= 63 made it
-        # marginal and re-routed 58/96 lambda windows (round-5
-        # measurement; Mosaic only needs W % 8, not % 128).
-        bw = band_w if (r == 0 or not band_w) else min(band_w, 192)
+        bw = round_band_width(band_w, r)
         bb, bbw, alen, begin, end, cov, ovf = make_round(bw, scales[r])(
             bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf)
     return _pack_body(bb[:-1], cov, alen[:-1], ovf)
@@ -707,7 +773,8 @@ def dispatch_chunk(plan: ChunkPlan, *, match: int, mismatch: int,
             bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf,
             match=match, mismatch=mismatch, gap=gap,
             ins_scale=scales[r], Lq=plan.Lq, n_win=plan.n_win,
-            LA=plan.LA, pallas=pallas, band_w=band_w)
+            LA=plan.LA, pallas=pallas,
+            band_w=round_band_width(band_w, r))
         t0 = sync(cov, f"compute/round{r}", t0)
     if stats is not None:
         stats["chunks"] = stats.get("chunks", 0) + 1

@@ -144,6 +144,12 @@ class PoaEngine:
         # Optional dict: run_chunk accumulates phase wall times into it
         # ("h2d"/"compute"/"d2h"/"chunks"); None = no timing syncs.
         self.stats = None
+        # Filled by the device path when the convergence scheduler runs
+        # (racon_tpu/sched/): per-round freeze histogram, survivor
+        # fractions, repack overhead. Accumulates across
+        # consensus_windows calls of one run; the polisher logs it and
+        # bench.py serializes it into extras.
+        self.sched_telemetry = None
         self._native = None
 
     # ------------------------------------------------------------ public API
@@ -251,18 +257,25 @@ class PoaEngine:
         total_jobs = sum(w.n_layers for w in active)
         n_chunks = max(1, -(-total_jobs // jobs_cap))
         target = -(-total_jobs // n_chunks)
-        # Pipeline: chunk i+1's h2d + dispatch go out while chunk i
-        # still computes (depth 2 bounds in-flight HBM). Stats collection
-        # forces depth 0 (strictly sequential) so every phase time stays
-        # attributable to its chunk (the pack timestamp lives in the
-        # shared stats dict).
-        depth = 0 if self.stats is not None else 2
-        pending: List[Tuple[List[Window], object, object]] = []
+        groups: List[List[Window]] = []
+        i = 0
+        while i < len(active):
+            ws: List[Window] = []
+            jobs = 0
+            while i < len(active) and \
+                    (not ws or jobs + active[i].n_layers <= target):
+                ws.append(active[i])
+                jobs += active[i].n_layers
+                i += 1
+            groups.append(ws)
+        n_shards = self.mesh.shape["dp"] if self.mesh is not None else 1
         trunc: List[Window] = []
 
-        def finish(entry) -> None:
-            ws, plan, packed = entry
-            codes, covs = collect_chunk(plan, packed, stats=self.stats)
+        def make_plan(ws: List[Window]) -> ChunkPlan:
+            return ChunkPlan(ws, lq_cap=lq_cap, la_cap=la_cap,
+                             n_shards=n_shards, band_cap=w_run or None)
+
+        def apply(ws, codes, covs) -> None:
             for w, c, cv in zip(ws, codes, covs):
                 if c is None:
                     # Consensus outgrew the chunk's padded anchor width
@@ -274,30 +287,59 @@ class PoaEngine:
                     decode_bases(np.frombuffer(c, dtype=np.uint8)), cv,
                     log=self.log)
 
-        i = 0
-        while i < len(active):
-            ws: List[Window] = []
-            jobs = 0
-            while i < len(active) and \
-                    (not ws or jobs + active[i].n_layers <= target):
-                ws.append(active[i])
-                jobs += active[i].n_layers
-                i += 1
-            plan = ChunkPlan(ws, lq_cap=lq_cap, la_cap=la_cap,
-                             n_shards=(self.mesh.shape["dp"]
-                                       if self.mesh is not None else 1),
-                             band_cap=w_run or None)
-            packed = dispatch_chunk(
-                plan, match=self.match, mismatch=self.mismatch,
-                gap=self.gap,
-                ins_scale=self._round_scales(self.refine_rounds + 1),
-                rounds=self.refine_rounds + 1, stats=self.stats,
-                mesh=self.mesh)
-            pending.append((ws, plan, packed))
-            if len(pending) > depth:
-                finish(pending.pop(0))
-        for entry in pending:
-            finish(entry)
+        from racon_tpu.sched import (ConvergenceScheduler, SchedTelemetry,
+                                     sched_enabled)
+        if sched_enabled():
+            # Convergence-aware path (racon_tpu/sched/): per-window
+            # early exit with survivor repacking. Its per-round host
+            # syncs preclude the fixed path's depth-2 dispatch pipeline,
+            # so overlap comes from prefetching the NEXT chunk's h2d
+            # (async device_put) before running the current rounds.
+            rounds = self.refine_rounds + 1
+            if self.sched_telemetry is None or \
+                    self.sched_telemetry.rounds != rounds:
+                self.sched_telemetry = SchedTelemetry(rounds)
+            sched = ConvergenceScheduler(
+                match=self.match, mismatch=self.mismatch, gap=self.gap,
+                scales=self._round_scales(rounds), mesh=self.mesh,
+                telemetry=self.sched_telemetry)
+            plan = make_plan(groups[0]) if groups else None
+            bufs = sched.put_chunk(plan) if plan is not None else None
+            for k, ws in enumerate(groups):
+                cur_plan, cur_bufs = plan, bufs
+                if k + 1 < len(groups):
+                    plan = make_plan(groups[k + 1])
+                    bufs = sched.put_chunk(plan)
+                codes, covs = sched.run_chunk(cur_plan, bufs=cur_bufs,
+                                              stats=self.stats)
+                apply(ws, codes, covs)
+        else:
+            # Fixed-round pipeline: chunk i+1's h2d + dispatch go out
+            # while chunk i still computes (depth 2 bounds in-flight
+            # HBM). Stats collection forces depth 0 (strictly
+            # sequential) so every phase time stays attributable to its
+            # chunk (the pack timestamp lives in the shared stats dict).
+            depth = 0 if self.stats is not None else 2
+            pending: List[Tuple[List[Window], object, object]] = []
+
+            def finish(entry) -> None:
+                ws, plan, packed = entry
+                codes, covs = collect_chunk(plan, packed, stats=self.stats)
+                apply(ws, codes, covs)
+
+            for ws in groups:
+                plan = make_plan(ws)
+                packed = dispatch_chunk(
+                    plan, match=self.match, mismatch=self.mismatch,
+                    gap=self.gap,
+                    ins_scale=self._round_scales(self.refine_rounds + 1),
+                    rounds=self.refine_rounds + 1, stats=self.stats,
+                    mesh=self.mesh)
+                pending.append((ws, plan, packed))
+                if len(pending) > depth:
+                    finish(pending.pop(0))
+            for entry in pending:
+                finish(entry)
         if trunc:
             print(f"[racon_tpu::PoaEngine] {len(trunc)} window(s) "
                   "outgrew the device anchor budget; re-polishing on "

@@ -30,6 +30,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from racon_tpu.utils.jaxcompat import pvary, shard_map
+
 
 def make_mesh(n_devices: Optional[int] = None,
               axes: Tuple[str, ...] = ("dp",),
@@ -151,8 +153,8 @@ def _sp_forward(sp, nsp, jglob, qv, tv, a, *, match, mismatch, gap,
     ii = jnp.arange(1, qv.shape[0] + 1, dtype=jnp.int32)
     # The scan body outputs are dp-varying (they read qv/tv), so the
     # initial carry must carry the same varying-axes type.
-    carry0 = (jax.lax.pvary(row0, ("dp",)),
-              jax.lax.pvary(jnp.int32(halo0), ("dp",)))
+    carry0 = (pvary(row0, ("dp",)),
+              pvary(jnp.int32(halo0), ("dp",)))
     (final, _), dirs = jax.lax.scan(step, carry0,
                                     (ii, qv.astype(jnp.int32)))
     return final, dirs
@@ -181,10 +183,10 @@ def _sp_scores_jit(q, t, lq, lt, *, match, mismatch, gap, mesh):
 
         return jax.vmap(one)(qb, tb, lqb, ltb)
 
-    fn = jax.shard_map(
+    fn = shard_map(
         block, mesh=mesh,
         in_specs=(P("dp", None), P("dp", "sp"), P("dp"), P("dp")),
-        out_specs=P("dp"))
+        out_specs=P("dp"), check_vma=False)
     return fn(q, t, lq, lt)
 
 
@@ -260,7 +262,7 @@ def _sp_align_jit(q, t, lq, lt, *, match, mismatch, gap, mesh):
 
         return jax.vmap(one)(qb, tb, lqb, ltb)
 
-    fn = jax.shard_map(
+    fn = shard_map(
         block, mesh=mesh,
         in_specs=(P("dp", None), P("dp", "sp"), P("dp"), P("dp")),
         out_specs=P("dp", None), check_vma=False)

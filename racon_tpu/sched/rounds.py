@@ -1,0 +1,276 @@
+"""Device programs for the convergence scheduler.
+
+Three jitted entry points, all shape-stable per chunk:
+
+- :func:`sched_unpack` — ChunkPlan byte buffers -> round-state arrays
+  plus fresh device-resident output accumulators (indexed by ORIGINAL
+  window id for the chunk's whole lifetime).
+- :func:`sched_rounds` — one dispatch running 1..k refinement rounds,
+  detecting fixed points on the last of them and scattering frozen
+  windows' outputs into the accumulators. The freeze-everything flag
+  (``last``) is a TRACED scalar, so every single-round dispatch of the
+  tail (global rounds 2..R-1) shares ONE compiled executable.
+- :func:`sched_repack` — gather-compaction of survivor state onto the
+  dense lane/window axes a host RepackPlan laid out.
+
+Why a frozen window's output is bit-identical to the fixed engine's:
+
+1. All non-final rounds share one insertion-vote scale (PoaEngine's
+   schedule is [base]*(R-1) + [final]), and from round 1 on anchors
+   carry zero weights. So for rounds 1 <= r < R-1 the round function is
+   literally replayed: if round r reproduced its own input state
+   (anchor bytes + length + every lane span — the converged_windows
+   predicate), rounds r+1..R-2 reproduce it again, vote-for-vote.
+2. The final round differs ONLY in the assembly scale — alignment and
+   vote extraction never see ins_scale. Its votes therefore equal the
+   detection round's votes, and assembling THOSE votes at the final
+   scale (the dual assembly below, computed every round from the same
+   accumulators) IS the fixed engine's final output for that window.
+3. Replay rounds also share the narrowed band width
+   (device_poa.round_band_width, r >= 1 in both engines), so the
+   escape-bound redo flags replay identically too.
+
+Per-window convergence (not per-lane): one window's lanes vote into one
+accumulator, so a single moved span can shift the whole window's merge —
+the freeze unit must be the window. Detection starts at round 1 (the
+round-0 anchor carries backbone quality weights; see
+device_merge.converged_windows).
+
+Caveat (shared with the dp-sharded engine, see ops/device_poa.py's
+module docstring): repacking changes the batch size the vote matmul
+accumulates over, which may reassociate the few FRACTIONAL f32 channels
+(w_read-derived) for windows still live after round 2 — sub-epsilon
+ties could in principle break differently there. Integer-weight
+channels are exact at any batch size, and windows frozen at round 1
+(the common case) never see a repacked batch.
+"""
+
+from __future__ import annotations
+
+import functools
+
+
+def _sched_core(bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf, *,
+                match, mismatch, gap, scale, scale_final, Lq, n_win, LA,
+                pallas, band_w, detect, axis_name=None):
+    """One detecting round (traced body, single shard's view).
+
+    _round_core's alignment+merge (shared via device_poa._lane_votes /
+    _remap_state) plus (a) the per-window fixed-point predicate and
+    (b) a final-scale assembly of the same vote accumulators.
+
+    Returns (new_bb, new_bbw, new_alen, new_begin, new_end, conv, ovf,
+    ovf_f, codes_f, cov_f, total_f): ``conv`` bool[n_win] fixed-point
+    flags (all-False when ``detect`` is off), ``ovf`` the sticky
+    host-redo flag (band escape / saturation / base-assembly overflow),
+    ``ovf_f``/``codes_f``/``cov_f``/``total_f`` the final-scale output
+    candidate a freezing window records.
+    """
+    import jax
+    import jax.numpy as jnp
+    from racon_tpu.ops import device_merge as dm
+    from racon_tpu.ops.device_poa import _lane_votes, _remap_state
+
+    votes, esc_w = _lane_votes(
+        bb, alen, begin, end, q, qw8, lq, w_read, win, match=match,
+        mismatch=mismatch, gap=gap, Lq=Lq, LA=LA, pallas=pallas,
+        band_w=band_w)
+    acc = dm.aggregate_votes(votes, win, n_win + 1, extras={"_esc": esc_w})
+    if axis_name is not None:
+        acc = {k: jax.lax.psum(v, axis_name) for k, v in acc.items()}
+    wesc = acc.pop("_esc")
+    acc = {k: v[:-1] for k, v in acc.items()}       # drop padded-lane row
+    acc = dm.add_backbone(acc, bb[:-1], bbw[:-1], alen[:-1])
+    asm = dm.assemble(acc, alen[:-1], scale)
+    codes, cov, total = dm.compact(asm, LA)
+    map_b, map_e = dm.coord_maps(asm, alen[:-1], LA)
+    new_bb, new_alen, nb, ne = _remap_state(
+        codes, total, map_b, map_e, bb, alen, begin, end, win, LA)
+    new_bbw = jnp.zeros_like(bbw)
+    # Sticky-flag split: ``ovf`` (carried state) folds in this round's
+    # BASE-scale assembly overflow, exactly like the fixed engine's
+    # intermediate rounds; ``ovf_pre`` leaves it out, because the fixed
+    # engine's FINAL round assembles at the final scale only — a window
+    # frozen by the schedule's end must not inherit an overflow verdict
+    # from an assembly the fixed engine never ran. (For converged
+    # windows the two coincide: a fixed point has total == alen_old
+    # <= LA.) sched_rounds picks per freeze reason.
+    ovf_pre = ovf | (wesc[:-1] > 0)
+    ovf = ovf_pre | (total > LA)
+
+    if detect:
+        # Span-change flags ride a second tiny membership matmul (and
+        # one extra psum under dp — nb/ne only exist after the maps, so
+        # they cannot ride the vote aggregation's psum).
+        chg = ((nb != begin) | (ne != end)).astype(jnp.float32)
+        wchg = dm.aggregate_flags(chg, win, n_win + 1)
+        if axis_name is not None:
+            wchg = jax.lax.psum(wchg, axis_name)
+        conv = dm.converged_windows(codes, total, bb[:-1], alen[:-1],
+                                    wchg[:-1])
+    else:
+        conv = jnp.zeros(n_win, dtype=bool)
+
+    # Dual assembly: the final-scale output candidate, from the SAME
+    # accumulators (free of alignment cost — assemble+compact only).
+    if scale_final != scale:
+        asm_f = dm.assemble(acc, alen[:-1], scale_final)
+        codes_f, cov_f, total_f = dm.compact(asm_f, LA)
+    else:
+        codes_f, cov_f, total_f = codes, cov, total
+    ovf_f = ovf_pre | (total_f > LA)
+    return (new_bb, new_bbw, new_alen, nb, ne, conv, ovf, ovf_f,
+            codes_f, cov_f, total_f)
+
+
+def _make_sched_fn(*, match, mismatch, gap, scale, scale_final, Lq, n_win,
+                   LA, pallas, band_w, detect, mesh):
+    """_sched_core, or its dp-sharded shard_map under a mesh (same
+    sharding contract as device_poa._make_round_fn: job axis over "dp",
+    window arrays replicated, psums inside the core)."""
+    core = functools.partial(
+        _sched_core, match=match, mismatch=mismatch, gap=gap, scale=scale,
+        scale_final=scale_final, Lq=Lq, n_win=n_win, LA=LA, pallas=pallas,
+        band_w=band_w, detect=detect,
+        axis_name=None if mesh is None else "dp")
+    if mesh is None:
+        return core
+    from jax.sharding import PartitionSpec as P
+    from racon_tpu.utils.jaxcompat import shard_map
+    rep = P()
+    job = P("dp")
+    return shard_map(
+        core, mesh=mesh,
+        in_specs=(rep, rep, rep, job, job, job, job, job, job, job, rep),
+        out_specs=(rep, rep, rep, job, job, rep, rep, rep, rep, rep, rep),
+        check_vma=False)
+
+
+@functools.partial(
+    __import__("jax").jit, static_argnames=("Lq", "LA", "n_win"))
+def sched_unpack(job_buf, win_buf, *, Lq, LA, n_win):
+    """Unpack a chunk's packed byte buffers into round state plus fresh
+    output accumulators (one dispatch; the zeros materialize on device).
+
+    The accumulators are indexed by ORIGINAL window row for the chunk's
+    whole lifetime — row ``n_win`` is the trash row non-frozen (and
+    padded) writes land in. Returns (bb, bbw, alen, begin, end, q, qw8,
+    lq, w_read, win, ovf, out_codes, out_cov, out_total, out_ovf).
+    """
+    import jax.numpy as jnp
+    from racon_tpu.ops.device_poa import _unpack_bufs
+
+    (q, qw8, begin, end, lq, win, w_read, bb, bbw, alen) = \
+        _unpack_bufs(job_buf, win_buf, Lq, LA)
+    ovf = jnp.zeros(n_win, dtype=bool)
+    out_codes = jnp.zeros((n_win + 1, LA), jnp.uint8)
+    out_cov = jnp.zeros((n_win + 1, LA), jnp.int32)
+    out_total = jnp.ones(n_win + 1, jnp.int32)
+    out_ovf = jnp.zeros(n_win + 1, dtype=bool)
+    return (bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf,
+            out_codes, out_cov, out_total, out_ovf)
+
+
+@functools.partial(
+    __import__("jax").jit,
+    static_argnames=("match", "mismatch", "gap", "scale", "scale_final",
+                     "Lq", "n_win", "LA", "pallas", "band_ws", "detect",
+                     "mesh"))
+def sched_rounds(bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf,
+                 out_codes, out_cov, out_total, out_ovf, orig_ids, last, *,
+                 match, mismatch, gap, scale, scale_final, Lq, n_win, LA,
+                 pallas, band_ws, detect, mesh=None):
+    """Run ``len(band_ws)`` refinement rounds in one dispatch, detect on
+    the last of them, and scatter frozen windows' final-scale outputs.
+
+    ``orig_ids`` int32[n_win] maps current window rows to accumulator
+    rows (padding rows -> trash). ``last`` is a TRACED bool scalar:
+    True freezes every remaining window (the final global round) —
+    traced so tail dispatches of different global rounds share one
+    executable. A window freezes when it converged, went overflow (its
+    redo verdict cannot change — the flag is sticky in the fixed engine
+    too), or the schedule ended.
+    """
+    import jax.numpy as jnp
+
+    conv = jnp.zeros(n_win, dtype=bool)
+    for i, bw in enumerate(band_ws):
+        fn = _make_sched_fn(
+            match=match, mismatch=mismatch, gap=gap, scale=scale,
+            scale_final=scale_final, Lq=Lq, n_win=n_win, LA=LA,
+            pallas=pallas, band_w=bw,
+            detect=detect and i == len(band_ws) - 1, mesh=mesh)
+        (bb, bbw, alen, begin, end, conv, ovf, ovf_f, codes_f, cov_f,
+         total_f) = fn(bb, bbw, alen, begin, end, q, qw8, lq, w_read,
+                       win, ovf)
+    freeze = conv | ovf | last
+    trash = out_codes.shape[0] - 1
+    sel = jnp.where(freeze, orig_ids, trash)
+    out_codes = out_codes.at[sel].set(codes_f)
+    out_cov = out_cov.at[sel].set(cov_f)
+    # clip like _round_core's new_alen: the fixed engine's output length
+    # is the NEXT state's alen (ovf covers total_f > LA).
+    out_total = out_total.at[sel].set(jnp.clip(total_f, 1, LA))
+    # Freeze-reason-matched flag: a schedule-end freeze records ovf_f
+    # (no base-scale assembly runs in the fixed engine's final round);
+    # a conv/ovf freeze keeps the carried sticky flag plus the frozen
+    # output's own final-scale overflow (see _sched_core).
+    out_ovf = out_ovf.at[sel].set(
+        jnp.where(last, ovf_f, ovf | (total_f > LA)))
+    return (bb, bbw, alen, begin, end, ovf, conv,
+            out_codes, out_cov, out_total, out_ovf)
+
+
+@functools.partial(__import__("jax").jit, static_argnames=("mesh",))
+def sched_repack(bb, bbw, alen, begin, end, q, qw8, lq, w_read, ovf,
+                 lane_idx, new_win, win_map, win_real, *, mesh=None):
+    """Gather-compact survivor state onto new dense lane/window axes.
+
+    Index vectors come from a host RepackPlan: ``lane_idx`` int32[B']
+    old lane positions (padded -> 0), ``new_win`` int32[B'] new window
+    per lane (padded -> dummy n_win'), ``win_map`` int32[n_win'+1] old
+    window row per new row (padded + dummy -> old dummy row),
+    ``win_real`` bool[n_win']. Padded lanes are re-dummied (lq=1,
+    begin=0, end=1, w_read=0) exactly like ChunkPlan padding. Returns
+    (bb, bbw, alen, begin, end, q, qw8, lq, w_read, ovf) on the new
+    axes; the caller carries ``new_win`` forward as the win array.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    pad = new_win == (win_map.shape[0] - 1)
+
+    def glane(a, fill=None):
+        out = jnp.take(a, lane_idx, axis=0)
+        if fill is not None:
+            out = jnp.where(pad, jnp.asarray(fill, out.dtype), out)
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            spec = P("dp") if out.ndim == 1 else P("dp", None)
+            out = jax.lax.with_sharding_constraint(
+                out, NamedSharding(mesh, spec))
+        return out
+
+    nbb = jnp.take(bb, win_map, axis=0)
+    nalen = jnp.take(alen, win_map, axis=0)
+    # Anchor weights are identically zero from round 1 on (anchors
+    # re-vote with neutral weights) and repack only runs after >= 2
+    # rounds — materialize the zeros instead of gathering them.
+    nbbw = jnp.zeros(nbb.shape, jnp.float32)
+    novf = jnp.where(
+        win_real,
+        jnp.take(ovf, jnp.clip(win_map[:-1], 0, ovf.shape[0] - 1)),
+        False)
+    return (nbb, nbbw, nalen,
+            glane(begin, 0), glane(end, 1), glane(q), glane(qw8),
+            glane(lq, 1), glane(w_read, 0.0), novf)
+
+
+@__import__("jax").jit
+def sched_pack(out_codes, out_cov, out_total, out_ovf):
+    """Pack the output accumulators (trash row dropped) into the SAME
+    d2h byte layout as the fixed engine (device_poa._pack_body), so
+    collect_chunk unpacks scheduler output unchanged."""
+    from racon_tpu.ops.device_poa import _pack_body
+    return _pack_body(out_codes[:-1], out_cov[:-1], out_total[:-1],
+                      out_ovf[:-1])

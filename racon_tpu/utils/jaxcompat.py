@@ -1,0 +1,37 @@
+"""Portability shims for jax APIs that moved across releases.
+
+The sharded paths target the current jax surface (``jax.shard_map`` with
+``check_vma``, ``jax.lax.pvary``); older toolchains (0.4.x) expose
+shard_map only under ``jax.experimental.shard_map`` (with ``check_rep``
+instead of ``check_vma``) and have no varying-axes typing at all, where
+``pvary`` is the identity by construction. Every call site routes
+through this module so the supported API is picked once, at import time,
+instead of tripping AttributeErrors / DeprecationWarnings per trace.
+"""
+
+from __future__ import annotations
+
+import jax
+
+# On 0.4.x `jax.shard_map` is a registered deprecation stub that raises
+# AttributeError on access, so hasattr is the correct probe.
+if hasattr(jax, "shard_map"):
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+else:
+    from jax.experimental.shard_map import shard_map as _shard_map_legacy
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return _shard_map_legacy(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_rep=check_vma)
+
+
+if hasattr(jax.lax, "pvary"):
+    pvary = jax.lax.pvary
+else:
+    def pvary(x, axis_names):
+        # Pre-varying-axes jax: every shard_map intermediate is already
+        # implicitly device-varying; nothing to annotate.
+        del axis_names
+        return x

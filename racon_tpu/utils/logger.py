@@ -51,6 +51,15 @@ class Logger:
         elapsed = time.perf_counter() - self._t0
         print(f"{msg} {elapsed:.6f} s", file=self.stream)
 
+    def sched_summary(self, telem) -> None:
+        """One-line convergence-scheduler telemetry (a SchedTelemetry
+        from racon_tpu/sched/ — keys documented in docs/SCHEDULER.md)."""
+        if self._bar:
+            print(file=self.stream)
+            self._bar = 0
+        print("[racon_tpu::Polisher::polish] scheduler " + telem.summary(),
+              file=self.stream)
+
 
 class NullLogger(Logger):
     """Silent logger for tests/library use."""
@@ -68,4 +77,7 @@ class NullLogger(Logger):
         pass
 
     def total(self, msg: str) -> None:
+        pass
+
+    def sched_summary(self, telem) -> None:
         pass

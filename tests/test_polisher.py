@@ -160,6 +160,62 @@ def test_consensus_edit_distance_scoring(ref_data_module, reference_genome):
     _check(out, reference_genome, 1321, 1230)
 
 
+# The six reference acceptance configs (racon_test.cpp:87-217), used by
+# the scheduler differential below: reads, overlaps, window, scores, and
+# the reference golden ED.
+_GOLDEN_CONFIGS = [
+    ("sample_reads.fastq.gz", "sample_overlaps.sam.gz", 500,
+     (5, -4, -8), 1317),
+    ("sample_reads.fastq.gz", "sample_overlaps.paf.gz", 500,
+     (5, -4, -8), 1312),
+    ("sample_reads.fasta.gz", "sample_overlaps.paf.gz", 500,
+     (5, -4, -8), 1566),
+    ("sample_reads.fasta.gz", "sample_overlaps.sam.gz", 500,
+     (5, -4, -8), 1770),
+    ("sample_reads.fastq.gz", "sample_overlaps.paf.gz", 1000,
+     (5, -4, -8), 1289),
+    ("sample_reads.fastq.gz", "sample_overlaps.paf.gz", 500,
+     (1, -1, -1), 1321),
+]
+_GOLDEN_IDS = ["sam_fastq", "paf_fastq", "paf_fasta", "sam_fasta",
+               "window1000", "edit_scores"]
+
+
+def _polish_device(ref_data_module, reads, overlaps, window=500,
+                   scores=(5, -4, -8)):
+    p = create_polisher(
+        ref_data_module(reads), ref_data_module(overlaps),
+        ref_data_module("sample_layout.fasta.gz"), PolisherType.kC,
+        window, 10.0, 0.3, *scores, backend="jax")
+    p.initialize()
+    return p.polish(True)
+
+
+@pytest.mark.ava
+@pytest.mark.parametrize("reads,overlaps,window,scores,golden",
+                         _GOLDEN_CONFIGS, ids=_GOLDEN_IDS)
+def test_sched_differential_golden(ref_data_module, reference_genome,
+                                   monkeypatch, reads, overlaps, window,
+                                   scores, golden):
+    """The convergence scheduler (racon_tpu/sched/) must be
+    BIT-IDENTICAL to the fixed-round engine on every reference
+    acceptance config — a frozen window's recorded consensus is the
+    final-scale replay of its detection round, so any divergence is a
+    scheduler bug, not noise. ci.sh runs the sam_fastq case in the
+    default tier; --full runs all six."""
+    monkeypatch.setenv("RACON_TPU_SCHED", "0")
+    fixed = _polish_device(ref_data_module, reads, overlaps, window,
+                           scores)
+    monkeypatch.setenv("RACON_TPU_SCHED", "1")
+    sched = _polish_device(ref_data_module, reads, overlaps, window,
+                           scores)
+    assert [s.data for s in sched] == [s.data for s in fixed]
+    assert [s.name for s in sched] == [s.name for s in fixed]
+    ed = _edit_distance(reverse_complement(sched[0].data),
+                        reference_genome)
+    assert ed <= int(golden * 1.25), f"ED {ed} vs golden {golden}"
+
+
 @pytest.mark.ava
 def test_consensus_device_engine_golden_sam_fastq(ref_data_module,
                                                   reference_genome):

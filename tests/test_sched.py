@@ -1,0 +1,230 @@
+"""Tests for the convergence-aware refinement scheduler (racon_tpu/sched/).
+
+Covers the survivor-repacking planner (shape buckets, padding, lane-index
+round-trip), the telemetry counters, the scale-schedule validation, and —
+the load-bearing part — bit-identity of the scheduled engine against the
+fixed-round engine (RACON_TPU_SCHED=0) on every control-flow path the
+chunk driver has: the fused tail (low convergence), the repack loop (high
+convergence), full early exit (every window converges), and the repack
+loop under a dp mesh (repacked chunks must stay dp-shardable).
+"""
+
+import numpy as np
+import pytest
+
+from racon_tpu.models.window import Window, WindowType
+from racon_tpu.ops.encode import decode_bases
+from racon_tpu.sched import (ConvergenceScheduler, RepackPlan,
+                             SchedTelemetry, sched_enabled)
+
+
+# --------------------------------------------------------------- RepackPlan
+
+
+def _toy_plan(n_shards=1):
+    # 8 current rows (6 real + 2 padded), dummy row id 8, original trash
+    # row 100. Survivors: rows 0, 2, 3, 6.
+    surv = np.array([1, 0, 1, 1, 0, 0, 1, 0], bool)
+    win = np.array([0, 0, 1, 2, 2, 3, 4, 5, 6, 8, 8, 8], np.int32)
+    orig_ids = np.array([10, 11, 12, 13, 14, 15, 16, 17], np.int32)
+    return surv, win, orig_ids, RepackPlan(surv, win, orig_ids, trash=100,
+                                           n_shards=n_shards)
+
+
+def test_repack_plan_shape_buckets():
+    from racon_tpu.ops.device_poa import _bucket_b, _round_up
+    for n_shards in (1, 4, 8):
+        _, _, _, plan = _toy_plan(n_shards=n_shards)
+        assert plan.n_surv == 4
+        assert plan.n_win == 32                      # 32-grid window rows
+        assert plan.B % (128 * n_shards) == 0        # dp-shardable lanes
+        assert plan.B == _round_up(_bucket_b(max(plan.n_lanes, 1)),
+                                   128 * n_shards)
+        assert plan.B >= plan.n_lanes
+
+
+def test_repack_plan_padding():
+    surv, win, orig_ids, plan = _toy_plan()
+    n_win_cur = surv.shape[0]
+    # Real new rows map to the surviving old rows, in ascending order.
+    assert plan.win_map[:plan.n_surv].tolist() == [0, 2, 3, 6]
+    assert plan.win_real[:plan.n_surv].all()
+    assert plan.orig_ids[:plan.n_surv].tolist() == [10, 12, 13, 16]
+    # Padded rows and the new dummy row point at the OLD dummy row and
+    # the output trash row, so their writes land harmlessly.
+    assert (plan.win_map[plan.n_surv:] == n_win_cur).all()
+    assert not plan.win_real[plan.n_surv:].any()
+    assert (plan.orig_ids[plan.n_surv:] == 100).all()
+    # Padded lanes gather lane 0 (the fill masks re-dummy them) and
+    # belong to the new dummy window.
+    assert (plan.lane_idx[plan.n_lanes:] == 0).all()
+    assert (plan.new_win[plan.n_lanes:] == plan.n_win).all()
+
+
+def test_repack_plan_lane_round_trip():
+    surv, win, orig_ids, plan = _toy_plan()
+    # Surviving lanes, original order preserved.
+    assert plan.n_lanes == 6
+    assert plan.lane_idx[:plan.n_lanes].tolist() == [0, 1, 3, 4, 5, 8]
+    assert np.all(np.diff(plan.lane_idx[:plan.n_lanes]) > 0)
+    # Round trip: a new lane's window must resolve to the same ORIGINAL
+    # output row its old lane's window did.
+    for i in range(plan.n_lanes):
+        old_lane = plan.lane_idx[i]
+        assert (orig_ids[win[old_lane]]
+                == plan.orig_ids[plan.new_win[i]])
+
+
+# ------------------------------------------------- scheduler host-side bits
+
+
+def test_scheduler_rejects_varying_scales():
+    with pytest.raises(ValueError, match="uniform"):
+        ConvergenceScheduler(match=5, mismatch=-4, gap=-8,
+                             scales=(0.1, 0.2, 0.6))
+    with pytest.raises(ValueError, match="empty"):
+        ConvergenceScheduler(match=5, mismatch=-4, gap=-8, scales=())
+    s = ConvergenceScheduler(match=5, mismatch=-4, gap=-8,
+                             scales=(0.2, 0.2, 0.2, 0.6))
+    assert s.rounds == 4 and s.scale == 0.2 and s.scale_final == 0.6
+
+
+def test_sched_enabled_env(monkeypatch):
+    monkeypatch.delenv("RACON_TPU_SCHED", raising=False)
+    assert sched_enabled()
+    monkeypatch.setenv("RACON_TPU_SCHED", "0")
+    assert not sched_enabled()
+    monkeypatch.setenv("RACON_TPU_SCHED", "false")
+    assert not sched_enabled()
+    monkeypatch.setenv("RACON_TPU_SCHED", "1")
+    assert sched_enabled()
+
+
+def test_telemetry_counters():
+    t = SchedTelemetry(4)
+    t.record_chunk(10)
+    for r in range(2):
+        t.record_round(r, 10)
+    t.record_freeze(2, 6)          # 6 windows froze after 2 rounds
+    t.record_round(2, 4)
+    t.record_round(3, 4)
+    t.record_freeze(4, 4)          # the rest ran the full schedule
+    t.record_repack(0.25)
+    assert t.windows == 10 and t.chunks == 1
+    assert sum(t.hist.values()) == t.windows
+    assert t.survivor_frac() == [1.0, 1.0, 0.4, 0.4]
+    assert t.rounds_saved_frac() == pytest.approx(1 - 28 / 40)
+    ex = t.as_extras()
+    assert ex["sched_rounds_hist"] == {"2": 6, "4": 4}
+    assert ex["sched_repack_overhead_s"] == 0.25
+    assert ex["sched_dispatches_saved"] == 0
+    assert "windows=10" in t.summary()
+
+
+# ------------------------------------------------- differential bit-identity
+
+
+def _noisy(rng, seq, rate):
+    out = []
+    for b in seq:
+        r = rng.random()
+        if r < rate / 3:
+            continue
+        elif r < 2 * rate / 3:
+            out.append(int(rng.integers(0, 4)))
+        elif r < rate:
+            out.append(int(b))
+            out.append(int(rng.integers(0, 4)))
+        else:
+            out.append(int(b))
+    return decode_bases(np.array(out, np.uint8))
+
+
+def _noisy_batch(seed, n, wlen, layers, rate=0.1):
+    rng = np.random.default_rng(seed)
+    ws = []
+    for _ in range(n):
+        true = rng.integers(0, 4, wlen).astype(np.uint8)
+        backbone = _noisy(rng, true, rate)
+        w = Window(0, 0, WindowType.TGS, backbone, None)
+        for _ in range(layers):
+            w.add_layer(_noisy(rng, true, rate), None, 0,
+                        len(backbone) - 1)
+        ws.append(w)
+    return ws
+
+
+def _stable_batch(seed, n, wlen, layers=6):
+    """Windows whose layers equal the backbone: the merge is a fixed
+    point after round 1, so detection must freeze them at rounds_used=2."""
+    rng = np.random.default_rng(seed)
+    ws = []
+    for _ in range(n):
+        backbone = decode_bases(rng.integers(0, 4, wlen).astype(np.uint8))
+        w = Window(0, 0, WindowType.TGS, backbone, None)
+        for _ in range(layers):
+            w.add_layer(backbone, None, 0, len(backbone) - 1)
+        ws.append(w)
+    return ws
+
+
+def _mixed_batch():
+    """>32 real windows so the survivor set can halve the 32-grid window
+    bucket: 28 self-converging + 8 noisy forces the repack path."""
+    return _stable_batch(31, 28, 160) + _noisy_batch(32, 8, 160, 8)
+
+
+def _polish(factory, sched, monkeypatch, mesh=None):
+    from racon_tpu.ops.poa import PoaEngine
+    monkeypatch.setenv("RACON_TPU_SCHED", "1" if sched else "0")
+    ws = factory()
+    eng = PoaEngine(backend="jax", mesh=mesh)
+    eng.consensus_windows(ws)
+    return [w.consensus for w in ws], eng
+
+
+def test_sched_bit_identical_fused_tail(monkeypatch):
+    # 10% noise rarely reaches an exact fixed point, so the survivor set
+    # stays in the original shape bucket and the driver fuses the tail.
+    factory = lambda: _noisy_batch(21, 10, 200, 8)
+    ref, _ = _polish(factory, False, monkeypatch)
+    out, eng = _polish(factory, True, monkeypatch)
+    assert out == ref
+    t = eng.sched_telemetry
+    assert t.windows == 10
+    assert sum(t.hist.values()) == 10
+
+
+def test_sched_bit_identical_repack(monkeypatch):
+    ref, _ = _polish(_mixed_batch, False, monkeypatch)
+    out, eng = _polish(_mixed_batch, True, monkeypatch)
+    assert out == ref
+    t = eng.sched_telemetry
+    # Every self-converging window froze right after the detection round.
+    assert t.hist.get(2, 0) >= 28
+    assert t.rounds_saved_frac() > 0.3
+    assert sum(t.hist.values()) == t.windows == 36
+
+
+def test_sched_full_early_exit(monkeypatch):
+    factory = lambda: _stable_batch(41, 8, 150)
+    ref, _ = _polish(factory, False, monkeypatch)
+    out, eng = _polish(factory, True, monkeypatch)
+    assert out == ref
+    t = eng.sched_telemetry
+    assert t.hist == {2: 8}
+    # Rounds 2 and 3 never dispatched.
+    assert t.dispatches_saved == 2
+    assert t.rounds_saved_frac() == pytest.approx(0.5)
+
+
+def test_sched_repack_under_dp_mesh(monkeypatch):
+    # Acceptance: repacked chunks must remain dp-shardable. Quality-less
+    # layers keep the psum'd vote weights integral, so the sharded merge
+    # is exact and the comparison can demand bit equality.
+    from racon_tpu.parallel.dispatch import make_mesh
+    mesh = make_mesh(8, axes=("dp",))
+    ref, _ = _polish(_mixed_batch, False, monkeypatch, mesh=mesh)
+    out, eng = _polish(_mixed_batch, True, monkeypatch, mesh=mesh)
+    assert out == ref
+    assert eng.sched_telemetry.hist.get(2, 0) >= 28
