@@ -93,8 +93,11 @@ def main():
     wlen = 500
 
     import jax
+    from racon_tpu.obs import metrics as obs_metrics
+    from racon_tpu.obs.trace import configure as configure_trace
     from racon_tpu.ops.poa import PoaEngine, _accelerator_present
 
+    tracer = configure_trace()        # honors RACON_TPU_TRACE, else no-op
     backend = "jax" if _accelerator_present() else "native"
     dev = jax.devices()[0].platform if backend == "jax" else "cpu-native"
 
@@ -105,12 +108,20 @@ def main():
     eng.consensus_windows(build_windows(n_windows, coverage, wlen, seed=99))
 
     # End-to-end: pipelined (chunk i+1's h2d overlaps chunk i's compute).
+    # The registry resets after warmup so the transfer extras (h2d/d2h
+    # bytes, seconds, effective bandwidth — "tunnel weather" as a
+    # number) describe exactly the measured e2e run.
     windows = build_windows(n_windows, coverage, wlen)
     eng = PoaEngine(backend=backend)
+    obs_metrics.reset()
+    enable_compile_cache()            # re-record cache entry baseline
     t0 = time.perf_counter()
-    n_polished = eng.consensus_windows(windows)
+    with tracer.span("run", "bench_e2e", n_windows=n_windows):
+        n_polished = eng.consensus_windows(windows)
     dt = time.perf_counter() - t0
     assert n_polished == n_windows
+    e2e_transfers = obs_metrics.transfer_extras()
+    e2e_transfers = {f"e2e_{k}": v for k, v in e2e_transfers.items()}
 
     # Sanity: consensus must actually polish (each window was built from a
     # 10%-error backbone; consensus should be near the truth, i.e. differ
@@ -170,23 +181,32 @@ def main():
             for _ in range(reps):
                 sched.run_chunk(plan, bufs=(job_buf, win_buf))
             compute = n_sub / ((time.perf_counter() - t1) / reps)
-            sched_extras = sched.telemetry.as_extras()
+            # Registry-routed: publish the canonical sched_* keys and
+            # serialize them from there (same source the polisher's
+            # stderr summary formats from).
+            obs_metrics.publish_sched(sched.telemetry)
+            sched_extras = obs_metrics.sched_extras()
             sched_extras["fixed_engine_windows_per_sec"] = \
                 round(fixed_rate, 2)
     # Chunk pipelining overlaps h2d/compute/d2h, so pipelined end-to-end
     # reflects the tunnel-fed rate while compute-only is the chip rate;
     # both are reported.
-    print(json.dumps({
-        # metric_version 2: "value" is compute-only windows/s of a warm
-        # production chunk (the convergence scheduler's dispatch chain
-        # when RACON_TPU_SCHED is on — the default — else the fixed
-        # fused dispatch); e2e_* extras carry the tunnel-fed pipelined
-        # rate. Version 1 (rounds <= 5) timed the fixed fused dispatch
-        # only — that series continues under
+    from racon_tpu.utils.jaxcache import cache_extras
+    extras = {**sched_extras, **e2e_transfers, **cache_extras()}
+    out = {
+        # metric_version 3: same primary value as version 2 (compute-only
+        # windows/s of a warm production chunk — the convergence
+        # scheduler's dispatch chain when RACON_TPU_SCHED is on, the
+        # default, else the fixed fused dispatch), with extras now
+        # sourced from the obs metrics registry: e2e_h2d_* / e2e_d2h_*
+        # transfer accounting (bytes, seconds, effective bandwidth of
+        # the measured e2e run), dispatch counts, and compile-cache
+        # population. Version 1 (rounds <= 5) timed the fixed fused
+        # dispatch only — that series continues under
         # fixed_engine_windows_per_sec. Bump this whenever the primary
         # value's definition changes, so round-over-round comparisons
         # can't silently mix metrics.
-        "metric_version": 2,
+        "metric_version": 3,
         "metric": f"POA windows/sec/chip, compute-only (direct-timed warm "
                   f"production chunk, convergence-scheduled refinement "
                   f"rounds — racon_tpu/sched/, telemetry in sched_* "
@@ -210,8 +230,11 @@ def main():
         "cpu_anchor_1t_measured": CPU_1T_MEASURED,
         "vs_ref_spoa_64t_est": round(compute / CPU_64T_REF_SPOA_EST, 3),
         "n_windows": n_windows,
-        **sched_extras,
-    }))
+        **extras,
+    }
+    print(json.dumps(out))
+    tracer.finish(metrics={**obs_metrics.registry().snapshot(),
+                           "bench_value": out["value"]})
 
 
 if __name__ == "__main__":

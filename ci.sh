@@ -37,4 +37,7 @@ python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 echo "[ci] two-shape device-engine smoke"
 python scripts/two_shape_smoke.py
 
+echo "[ci] observability smoke (traced tiny polish + JSONL schema gate)"
+python scripts/obs_smoke.py
+
 echo "[ci] OK"

@@ -72,6 +72,11 @@ def build_parser() -> argparse.ArgumentParser:
                          "(coordinator/process env auto-detected on TPU "
                          "pods) before building the device mesh; combine "
                          "with --dp <total devices>")
+    ap.add_argument("--trace", metavar="PATH", default=None,
+                    help="write a structured JSONL run trace to PATH "
+                         "(same as RACON_TPU_TRACE=PATH; render with "
+                         "scripts/obs_report.py — see "
+                         "docs/OBSERVABILITY.md)")
     ap.add_argument("--version", action="store_true",
                     help="prints the version number")
     ap.add_argument("-h", "--help", action="store_true",
@@ -95,6 +100,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 1
     # Below every early return: --version/--help/usage errors should not
     # pay the jax import the cache setup triggers.
+    from racon_tpu.obs.trace import configure as configure_trace
+    tracer = configure_trace(args.trace)
     from racon_tpu.utils.jaxcache import enable_compile_cache
     enable_compile_cache()
 
@@ -136,14 +143,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         mesh = Mesh(_np.asarray(devs[:ndp]), ("dp",))
 
     try:
-        polisher = create_polisher(
-            args.paths[0], args.paths[1], args.paths[2],
-            PolisherType.kF if args.fragment_correction else PolisherType.kC,
-            args.window_length, args.quality_threshold, args.error_threshold,
-            args.match, args.mismatch, args.gap, backend=args.backend,
-            logger=logger, threads=args.threads, mesh=mesh)
-        polisher.initialize()
-        polished = polisher.polish(not args.include_unpolished)
+        with tracer.span("run", "racon_tpu"):
+            polisher = create_polisher(
+                args.paths[0], args.paths[1], args.paths[2],
+                PolisherType.kF if args.fragment_correction
+                else PolisherType.kC,
+                args.window_length, args.quality_threshold,
+                args.error_threshold, args.match, args.mismatch, args.gap,
+                backend=args.backend, logger=logger, threads=args.threads,
+                mesh=mesh)
+            polisher.initialize()
+            polished = polisher.polish(not args.include_unpolished)
     except (PolisherError, ParseError, ValueError) as exc:
         print(str(exc), file=sys.stderr)
         return 1
@@ -153,6 +163,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         out.write(b">" + seq.name.encode() + b"\n" + seq.data + b"\n")
     out.flush()
     logger.total("[racon_tpu::Polisher::] total =")
+    from racon_tpu.obs.metrics import registry as obs_registry
+    from racon_tpu.utils.jaxcache import cache_extras
+    reg = obs_registry()
+    for k, v in cache_extras(reg).items():
+        reg.set(k, v)
+    tracer.finish(metrics=reg.snapshot())
     return 0
 
 

@@ -341,7 +341,14 @@ class Polisher:
             log.tick("[racon_tpu::Polisher::polish] generating consensus")
         telem = getattr(self.engine, "sched_telemetry", None)
         if telem is not None and telem.windows:
-            log.sched_summary(telem)
+            # One source of truth: the counters go into the process
+            # metrics registry, and the stderr line is formatted from
+            # the same registry keys bench.py serializes.
+            from racon_tpu.obs.metrics import (publish_sched, registry,
+                                               sched_summary_line)
+            publish_sched(telem, registry())
+            log.line("[racon_tpu::Polisher::polish] scheduler " +
+                     sched_summary_line(registry()))
 
         dst: List[PolishedSequence] = []
         polished_data: List[bytes] = []

@@ -1,0 +1,28 @@
+"""Unified observability: structured run tracing + metrics registry.
+
+- :mod:`racon_tpu.obs.trace` — nested spans (run → phase → chunk →
+  round → dispatch → transfer) emitted as JSONL when
+  ``RACON_TPU_TRACE=<path>`` (or ``--trace``) is set; a no-op null
+  tracer otherwise.
+- :mod:`racon_tpu.obs.metrics` — process-wide counter registry: the
+  single source for the polisher's stderr scheduler summary,
+  ``SchedTelemetry.as_extras()``, and bench.py's JSON extras, plus
+  h2d/d2h transfer accounting (bytes, seconds, effective bandwidth)
+  and dispatch / compile-cache counters.
+
+Schema and env vars are documented in docs/OBSERVABILITY.md;
+``scripts/obs_report.py`` renders a trace into a per-stage breakdown.
+"""
+
+from racon_tpu.obs.trace import Tracer, NullTracer, get_tracer, configure
+from racon_tpu.obs.metrics import (MetricsRegistry, registry, reset,
+                                   record_h2d, record_d2h,
+                                   transfer_extras, publish_sched,
+                                   sched_extras, sched_summary_line)
+
+__all__ = [
+    "Tracer", "NullTracer", "get_tracer", "configure",
+    "MetricsRegistry", "registry", "reset",
+    "record_h2d", "record_d2h", "transfer_extras",
+    "publish_sched", "sched_extras", "sched_summary_line",
+]

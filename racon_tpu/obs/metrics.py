@@ -1,0 +1,199 @@
+"""Process-wide metrics registry — the single reporting source.
+
+Three consumers used to format/serialize their own counters and could
+drift: the polisher's stderr scheduler summary (utils/logger.py), the
+scheduler's ``SchedTelemetry.as_extras()``, and bench.py's JSON extras.
+They now all read one registry: :func:`publish_sched` writes the
+canonical ``sched_*`` keys, :func:`sched_summary_line` formats the
+stderr line from them, and :func:`transfer_extras` derives the
+h2d/d2h byte / second / effective-bandwidth numbers recorded at the
+transfer choke points (parallel/dispatch.py, ops/device_poa.py,
+sched/scheduler.py).
+
+Counter conventions (all keys appear in bench extras, metric_version 3;
+docs/OBSERVABILITY.md documents the full set):
+
+- ``h2d_bytes`` / ``h2d_s`` / ``h2d_transfers`` — bytes shipped to the
+  device, wall seconds of the ``device_put`` calls, call count.
+  device_put is asynchronous, so ``h2d_s`` measures the synchronous
+  (serialization + enqueue) portion — a lower bound on true transfer
+  time; through this environment's tunnel the call blocks on the wire
+  and the derived ``h2d_mb_per_s`` is the effective tunnel bandwidth.
+- ``d2h_bytes`` / ``d2h_s`` / ``d2h_transfers`` — device pulls
+  (``np.asarray`` on device values). A pull blocks until any residual
+  compute drains, so ``d2h_s`` is "time blocked pulling results" (the
+  number PROFILE.md decomposed by hand) and ``d2h_mb_per_s`` is a
+  lower bound on link bandwidth.
+- ``sched_flag_pulls`` / ``sched_flag_pull_s`` — the scheduler's
+  per-round convergence-flag pulls. These sync on compute, so their
+  time is accounted separately and never enters the bandwidth estimate.
+- ``device_dispatches`` — jitted chunk/round executions launched.
+- ``jax_cache_entries_start`` / ``jax_cache_entries_added`` — persistent
+  compile-cache population at enable time and entries added since
+  (= compiles this process paid; 0 on a fully warm cache), from
+  utils/jaxcache.py.
+
+No device syncs anywhere: every value rides on host data the pipeline
+already had in hand.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from racon_tpu.obs import trace as _trace
+
+
+class MetricsRegistry:
+    """Flat name -> value store: numeric counters plus JSON-ready
+    structured values (lists/dicts). Keys starting with ``_`` are
+    internal and excluded from snapshots."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._v: Dict[str, object] = {}
+
+    def inc(self, key: str, value: float = 1) -> None:
+        with self._lock:
+            self._v[key] = self._v.get(key, 0) + value
+
+    def set(self, key: str, value: object) -> None:
+        with self._lock:
+            self._v[key] = value
+
+    def get(self, key: str, default: object = 0) -> object:
+        with self._lock:
+            return self._v.get(key, default)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._v.clear()
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            return {k: v for k, v in self._v.items()
+                    if not k.startswith("_")}
+
+
+_REGISTRY = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    return _REGISTRY
+
+
+def reset() -> None:
+    _REGISTRY.reset()
+
+
+# ------------------------------------------------------------- transfers
+
+def record_h2d(nbytes: int, seconds: float,
+               reg: Optional[MetricsRegistry] = None,
+               name: str = "h2d") -> None:
+    """Account one host-to-device transfer (and trace it when tracing
+    is on)."""
+    reg = reg if reg is not None else _REGISTRY
+    reg.inc("h2d_bytes", int(nbytes))
+    reg.inc("h2d_s", float(seconds))
+    reg.inc("h2d_transfers")
+    _trace.get_tracer().point("transfer", name, dur_s=float(seconds),
+                              bytes=int(nbytes), dir="h2d")
+
+
+def record_d2h(nbytes: int, seconds: float,
+               reg: Optional[MetricsRegistry] = None,
+               name: str = "d2h") -> None:
+    """Account one device-to-host pull whose value was already computed
+    (so ``seconds`` measures transfer, not compute wait)."""
+    reg = reg if reg is not None else _REGISTRY
+    reg.inc("d2h_bytes", int(nbytes))
+    reg.inc("d2h_s", float(seconds))
+    reg.inc("d2h_transfers")
+    _trace.get_tracer().point("transfer", name, dur_s=float(seconds),
+                              bytes=int(nbytes), dir="d2h")
+
+
+def record_flag_pull(nbytes: int, seconds: float,
+                     reg: Optional[MetricsRegistry] = None) -> None:
+    """The scheduler's per-round flag pull: a sync point, so its time
+    includes compute wait and stays out of the bandwidth estimate."""
+    reg = reg if reg is not None else _REGISTRY
+    reg.inc("sched_flag_pulls")
+    reg.inc("sched_flag_pull_s", float(seconds))
+
+
+def transfer_extras(reg: Optional[MetricsRegistry] = None
+                    ) -> Dict[str, object]:
+    """Derived transfer numbers for bench extras / reports."""
+    reg = reg if reg is not None else _REGISTRY
+    out: Dict[str, object] = {}
+    for d in ("h2d", "d2h"):
+        b = int(reg.get(f"{d}_bytes", 0))
+        s = float(reg.get(f"{d}_s", 0.0))
+        n = int(reg.get(f"{d}_transfers", 0))
+        if not n:
+            continue
+        out[f"{d}_bytes"] = b
+        out[f"{d}_s"] = round(s, 4)
+        out[f"{d}_transfers"] = n
+        if s > 0:
+            out[f"{d}_mb_per_s"] = round(b / s / 1e6, 3)
+    n = int(reg.get("sched_flag_pulls", 0))
+    if n:
+        out["sched_flag_pulls"] = n
+        out["sched_flag_pull_s"] = round(
+            float(reg.get("sched_flag_pull_s", 0.0)), 4)
+    n = int(reg.get("device_dispatches", 0))
+    if n:
+        out["device_dispatches"] = n
+    return out
+
+
+# ------------------------------------------------------- sched telemetry
+
+#: Canonical sched_* registry keys (docs/SCHEDULER.md documents each).
+SCHED_KEYS = ("sched_rounds", "sched_windows", "sched_chunks",
+              "sched_rounds_hist", "sched_survivor_frac",
+              "sched_rounds_saved_frac", "sched_repack_overhead_s",
+              "sched_dispatches_saved")
+
+
+def publish_sched(telem, reg: Optional[MetricsRegistry] = None) -> None:
+    """Write a SchedTelemetry's counters into the registry under the
+    canonical ``sched_*`` keys — the one place their shape is defined."""
+    reg = reg if reg is not None else _REGISTRY
+    reg.set("sched_rounds", telem.rounds)
+    reg.set("sched_windows", telem.windows)
+    reg.set("sched_chunks", telem.chunks)
+    reg.set("sched_rounds_hist",
+            {str(k): v for k, v in sorted(telem.hist.items())})
+    reg.set("sched_survivor_frac",
+            [round(f, 4) for f in telem.survivor_frac()])
+    reg.set("sched_rounds_saved_frac", round(telem.rounds_saved_frac(), 4))
+    reg.set("sched_repack_overhead_s", round(telem.repack_s, 4))
+    reg.set("sched_dispatches_saved", telem.dispatches_saved)
+
+
+def sched_extras(reg: Optional[MetricsRegistry] = None
+                 ) -> Dict[str, object]:
+    """The registry's sched_* keys as a JSON-ready dict (bench extras)."""
+    reg = reg if reg is not None else _REGISTRY
+    return {k: reg.get(k) for k in SCHED_KEYS}
+
+
+def sched_summary_line(reg: Optional[MetricsRegistry] = None) -> str:
+    """The polisher's one-line stderr scheduler summary, formatted from
+    the registry (format kept stable across the registry refactor)."""
+    reg = reg if reg is not None else _REGISTRY
+    hist = reg.get("sched_rounds_hist", {}) or {}
+    hist_s = " ".join(f"r{k}:{v}" for k, v in
+                      sorted(hist.items(), key=lambda kv: int(kv[0])))
+    saved = float(reg.get("sched_rounds_saved_frac", 0.0))
+    repack = float(reg.get("sched_repack_overhead_s", 0.0))
+    return (f"windows={reg.get('sched_windows', 0)} "
+            f"chunks={reg.get('sched_chunks', 0)} "
+            f"frozen[{hist_s}] "
+            f"rounds_saved={saved:.0%} "
+            f"repack={repack:.3f}s")

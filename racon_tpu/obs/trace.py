@@ -1,0 +1,249 @@
+"""Structured run tracer: nested spans as JSONL.
+
+Disabled by default (the module-level :data:`NULL` tracer is a no-op on
+every call — no file handle, no clock reads beyond the caller's own).
+Enable by pointing ``RACON_TPU_TRACE`` at a file path, or pass
+``--trace <path>`` to the CLI (which calls :func:`configure`).
+
+Trace format (one JSON object per line):
+
+- ``{"ev": "begin", "schema": 1, "unix_time": ...}`` — first line.
+- ``{"ev": "span", "id": N, "parent": M|null, "kind": ..., "name": ...,
+  "t0": seconds-since-begin, "dur_s": ..., ...attrs}`` — one line per
+  *closed* span; children therefore appear before their parent. ``kind``
+  is one of run/phase/chunk/round/dispatch/transfer (plus free-form
+  kinds from future callers); numeric attrs (bytes, lanes, rounds, ...)
+  ride at the top level of the object.
+- ``{"ev": "metrics", ...}`` — a metrics-registry snapshot, written by
+  :meth:`Tracer.finish` (the CLI and bench call it on exit).
+
+``RACON_TPU_TRACE_XPROF=1`` additionally wraps every span in a
+``jax.profiler.TraceAnnotation`` so spans land in XLA device profiles;
+it is off by default because it imports jax at first span.
+
+Spans nest per thread (a thread-local stack supplies ``parent``); file
+writes are serialized by a lock. Close-time emission keeps the hot path
+to two ``time.perf_counter()`` calls and one dict build per span.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+SCHEMA_VERSION = 1
+
+ENV_TRACE = "RACON_TPU_TRACE"
+ENV_XPROF = "RACON_TPU_TRACE_XPROF"
+
+
+class _NullSpan:
+    """Shared no-op span: context manager with inert add/end."""
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def add(self, **attrs) -> "_NullSpan":
+        return self
+
+    def end(self) -> None:
+        pass
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class NullTracer:
+    """Disabled tracer: every operation is a no-op."""
+
+    enabled = False
+
+    def span(self, kind: str, name: str, **attrs):
+        return _NULL_SPAN
+
+    def emit(self, kind: str, name: str, t0_perf: float, dur_s: float,
+             **attrs) -> None:
+        pass
+
+    def point(self, kind: str, name: str, dur_s: float = 0.0,
+              **attrs) -> None:
+        pass
+
+    def finish(self, metrics: Optional[dict] = None) -> None:
+        pass
+
+
+NULL = NullTracer()
+
+
+class _Span:
+    __slots__ = ("tracer", "id", "parent", "kind", "name", "attrs",
+                 "t0", "_xprof", "_done")
+
+    def __init__(self, tracer: "Tracer", kind: str, name: str, attrs: dict):
+        self.tracer = tracer
+        self.kind = kind
+        self.name = name
+        self.attrs = attrs
+        self._xprof = None
+        self._done = False
+        self.id, self.parent = tracer._push(self)
+        self.t0 = time.perf_counter()
+        if tracer._xprof:
+            try:
+                import jax
+                self._xprof = jax.profiler.TraceAnnotation(
+                    f"{kind}:{name}")
+                self._xprof.__enter__()
+            except Exception:
+                self._xprof = None
+
+    def add(self, **attrs) -> "_Span":
+        """Attach counters to the span (merged into its JSONL record)."""
+        self.attrs.update(attrs)
+        return self
+
+    def end(self) -> None:
+        if self._done:
+            return
+        self._done = True
+        dur = time.perf_counter() - self.t0
+        if self._xprof is not None:
+            try:
+                self._xprof.__exit__(None, None, None)
+            except Exception:
+                pass
+        self.tracer._pop(self, dur)
+
+    def __enter__(self) -> "_Span":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.end()
+        return False
+
+
+class Tracer:
+    """JSONL span writer (see module docstring for the format)."""
+
+    enabled = True
+
+    def __init__(self, path: str):
+        self.path = path
+        self._t0 = time.perf_counter()
+        self._lock = threading.Lock()
+        self._local = threading.local()
+        self._next_id = 0
+        self._xprof = os.environ.get(ENV_XPROF, "") not in ("", "0",
+                                                            "false")
+        self._fh = open(path, "w", encoding="utf-8")
+        self._write({"ev": "begin", "schema": SCHEMA_VERSION,
+                     "unix_time": time.time()})
+
+    # ------------------------------------------------------------- internals
+
+    def _stack(self):
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    def _write(self, obj: dict) -> None:
+        line = json.dumps(obj, separators=(",", ":"))
+        with self._lock:
+            if self._fh is None:
+                return
+            self._fh.write(line + "\n")
+            self._fh.flush()
+
+    def _push(self, span: _Span):
+        st = self._stack()
+        parent = st[-1].id if st else None
+        with self._lock:
+            sid = self._next_id
+            self._next_id += 1
+        st.append(span)
+        return sid, parent
+
+    def _pop(self, span: _Span, dur: float) -> None:
+        st = self._stack()
+        # Tolerate out-of-order ends (manual .end() mixed with with-blocks):
+        # remove the span wherever it sits.
+        if span in st:
+            st.remove(span)
+        self._write({"ev": "span", "id": span.id, "parent": span.parent,
+                     "kind": span.kind, "name": span.name,
+                     "t0": round(span.t0 - self._t0, 6),
+                     "dur_s": round(dur, 6), **span.attrs})
+
+    # ------------------------------------------------------------ public API
+
+    def span(self, kind: str, name: str, **attrs) -> _Span:
+        """Open a nested span; close with ``with`` or ``.end()``."""
+        return _Span(self, kind, name, attrs)
+
+    def emit(self, kind: str, name: str, t0_perf: float, dur_s: float,
+             **attrs) -> None:
+        """Record a span that already ran, from its own perf_counter
+        start (utils/logger.py phases use this: the logger only learns
+        the phase name when the phase ends)."""
+        st = self._stack()
+        parent = st[-1].id if st else None
+        with self._lock:
+            sid = self._next_id
+            self._next_id += 1
+        self._write({"ev": "span", "id": sid, "parent": parent,
+                     "kind": kind, "name": name,
+                     "t0": round(max(t0_perf - self._t0, 0.0), 6),
+                     "dur_s": round(max(dur_s, 0.0), 6), **attrs})
+
+    def point(self, kind: str, name: str, dur_s: float = 0.0,
+              **attrs) -> None:
+        """Record an instantaneous-ish event (e.g. one transfer) ending
+        now, with ``dur_s`` of lead time."""
+        self.emit(kind, name, time.perf_counter() - dur_s, dur_s, **attrs)
+
+    def finish(self, metrics: Optional[dict] = None) -> None:
+        """Write a final metrics snapshot and close the file."""
+        if metrics:
+            self._write({"ev": "metrics", **metrics})
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+
+_tracer: Optional[object] = None
+
+
+def configure(path: Optional[str] = None):
+    """Install the process tracer. ``path=None`` reads RACON_TPU_TRACE;
+    empty/unset keeps tracing disabled. Idempotent for the same path;
+    a new path replaces (and closes) the previous tracer."""
+    global _tracer
+    path = path or os.environ.get(ENV_TRACE, "")
+    if not path:
+        if _tracer is None:
+            _tracer = NULL
+        return _tracer
+    if isinstance(_tracer, Tracer):
+        if _tracer.path == path:
+            return _tracer
+        _tracer.finish()
+    _tracer = Tracer(path)
+    return _tracer
+
+
+def get_tracer():
+    """The process tracer; configures from the environment on first use
+    so library runs honor RACON_TPU_TRACE without CLI involvement."""
+    if _tracer is None:
+        return configure()
+    return _tracer

@@ -715,6 +715,7 @@ def dispatch_chunk(plan: ChunkPlan, *, match: int, mismatch: int,
     pallas = _use_pallas(plan.B // ndp, plan.Lq, plan.LA)
     band_w = (0 if os.environ.get("RACON_TPU_NO_BAND", "")
               not in ("", "0", "false") else plan.band_w)
+    from racon_tpu.obs.metrics import record_h2d, registry as obs_registry
     t0 = time.perf_counter()
     if not verbose:
         # Production path: TWO h2d byte buffers, then the whole chunk
@@ -722,6 +723,7 @@ def dispatch_chunk(plan: ChunkPlan, *, match: int, mismatch: int,
         # and per-dispatch tunnel latency otherwise dominate. Stats
         # collection syncs once on each phase edge.
         job_h, win_h = plan.packed_bufs()
+        t_put = time.perf_counter()
         if mesh is None:
             job_buf, win_buf = jax.device_put((job_h, win_h))
         else:
@@ -730,6 +732,8 @@ def dispatch_chunk(plan: ChunkPlan, *, match: int, mismatch: int,
                 job_h, NamedSharding(mesh, PartitionSpec("dp")))
             win_buf = jax.device_put(
                 win_h, NamedSharding(mesh, PartitionSpec()))
+        record_h2d(job_h.nbytes + win_h.nbytes,
+                   time.perf_counter() - t_put, name="h2d/chunk")
         if collect:
             # Sync on BOTH buffers: device_put is async, and an
             # in-flight job_buf would otherwise bleed into "compute".
@@ -740,6 +744,7 @@ def dispatch_chunk(plan: ChunkPlan, *, match: int, mismatch: int,
             match=match, mismatch=mismatch, gap=gap, ins_scale=ins_scale,
             Lq=plan.Lq, n_win=plan.n_win, LA=plan.LA,
             pallas=pallas, band_w=band_w, rounds=rounds, mesh=mesh)
+        obs_registry().inc("device_dispatches")
         if collect:
             t0 = sync(packed, "compute", t0)
         if stats is not None:
@@ -751,6 +756,7 @@ def dispatch_chunk(plan: ChunkPlan, *, match: int, mismatch: int,
     # round's wall time stays attributable (RACON_TPU_TIMING=1).
     host_args = (plan.bb, plan.bbw, plan.alen, plan.begin, plan.end,
                  plan.q, plan.qw8, plan.lq, plan.w_read, plan.win)
+    t_put = time.perf_counter()
     if mesh is None:
         rnd = device_round
         dev_args = jax.device_put(host_args)
@@ -763,6 +769,8 @@ def dispatch_chunk(plan: ChunkPlan, *, match: int, mismatch: int,
         dev_args = tuple(jax.device_put(a, s)
                          for a, s in zip(host_args, shardings))
     bb, bbw, alen, begin, end, q, qw8, lq, w_read, win = dev_args
+    record_h2d(sum(a.nbytes for a in host_args),
+               time.perf_counter() - t_put, name="h2d/chunk")
     t0 = sync(alen, "h2d", t0)
     cov = None
     ovf = jnp.zeros(plan.n_win, dtype=bool)
@@ -775,6 +783,7 @@ def dispatch_chunk(plan: ChunkPlan, *, match: int, mismatch: int,
             ins_scale=scales[r], Lq=plan.Lq, n_win=plan.n_win,
             LA=plan.LA, pallas=pallas,
             band_w=round_band_width(band_w, r))
+        obs_registry().inc("device_dispatches")
         t0 = sync(cov, f"compute/round{r}", t0)
     if stats is not None:
         stats["chunks"] = stats.get("chunks", 0) + 1
@@ -795,8 +804,14 @@ def collect_chunk(plan: ChunkPlan, packed, stats: Optional[dict] = None
     truncated string.
     """
     import time
+    from racon_tpu.obs.metrics import record_d2h
 
+    t0 = time.perf_counter()
     ph = np.asarray(packed)
+    # The pull blocks until the chunk's compute drains too, so this is
+    # "time blocked in d2h", an upper bound on pure transfer (metrics
+    # module docstring discusses the bandwidth-estimate semantics).
+    record_d2h(ph.nbytes, time.perf_counter() - t0, name="d2h/chunk")
     if stats is not None and "_t_pack" in stats:
         stats["d2h"] = stats.get("d2h", 0.0) + \
             (time.perf_counter() - stats.pop("_t_pack"))

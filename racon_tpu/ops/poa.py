@@ -287,8 +287,10 @@ class PoaEngine:
                     decode_bases(np.frombuffer(c, dtype=np.uint8)), cv,
                     log=self.log)
 
+        from racon_tpu.obs.trace import get_tracer
         from racon_tpu.sched import (ConvergenceScheduler, SchedTelemetry,
                                      sched_enabled)
+        tracer = get_tracer()
         if sched_enabled():
             # Convergence-aware path (racon_tpu/sched/): per-window
             # early exit with survivor repacking. Its per-round host
@@ -310,8 +312,10 @@ class PoaEngine:
                 if k + 1 < len(groups):
                     plan = make_plan(groups[k + 1])
                     bufs = sched.put_chunk(plan)
-                codes, covs = sched.run_chunk(cur_plan, bufs=cur_bufs,
-                                              stats=self.stats)
+                with tracer.span("chunk", f"chunk{k}", windows=len(ws),
+                                 lanes=cur_plan.B, jobs=cur_plan.n_jobs):
+                    codes, covs = sched.run_chunk(cur_plan, bufs=cur_bufs,
+                                                  stats=self.stats)
                 apply(ws, codes, covs)
         else:
             # Fixed-round pipeline: chunk i+1's h2d + dispatch go out
@@ -320,14 +324,24 @@ class PoaEngine:
             # sequential) so every phase time stays attributable to its
             # chunk (the pack timestamp lives in the shared stats dict).
             depth = 0 if self.stats is not None else 2
-            pending: List[Tuple[List[Window], object, object]] = []
+            pending: List[tuple] = []
 
             def finish(entry) -> None:
-                ws, plan, packed = entry
+                # Chunks pipeline (dispatch i+1 overlaps compute i), so
+                # chunk spans are emitted retroactively at collect time:
+                # they overlap as siblings instead of nesting falsely.
+                ws, plan, packed, k, t_disp = entry
+                import time as _time
                 codes, covs = collect_chunk(plan, packed, stats=self.stats)
+                tracer.emit("chunk", f"chunk{k}", t_disp,
+                            _time.perf_counter() - t_disp,
+                            windows=len(ws), lanes=plan.B,
+                            jobs=plan.n_jobs)
                 apply(ws, codes, covs)
 
-            for ws in groups:
+            import time as _time
+            for k, ws in enumerate(groups):
+                t_disp = _time.perf_counter()
                 plan = make_plan(ws)
                 packed = dispatch_chunk(
                     plan, match=self.match, mismatch=self.mismatch,
@@ -335,7 +349,7 @@ class PoaEngine:
                     ins_scale=self._round_scales(self.refine_rounds + 1),
                     rounds=self.refine_rounds + 1, stats=self.stats,
                     mesh=self.mesh)
-                pending.append((ws, plan, packed))
+                pending.append((ws, plan, packed, k, t_disp))
                 if len(pending) > depth:
                     finish(pending.pop(0))
             for entry in pending:

@@ -22,6 +22,7 @@ embarrassingly-parallel tasks (reference: src/polisher.cpp:143-155,
 from __future__ import annotations
 
 import functools
+import time
 from typing import Optional, Sequence, Tuple
 
 import numpy as np
@@ -30,6 +31,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from racon_tpu.obs.metrics import record_d2h, record_h2d
 from racon_tpu.utils.jaxcompat import pvary, shard_map
 
 
@@ -79,10 +81,14 @@ def shard_align_inputs(mesh: Mesh, q: np.ndarray, t: np.ndarray,
         lt = np.concatenate([lt, np.ones(Bp - B, lt.dtype)])
     row = NamedSharding(mesh, P(axis, None))
     vec = NamedSharding(mesh, P(axis))
-    return (jax.device_put(jnp.asarray(q), row),
-            jax.device_put(jnp.asarray(t), row),
-            jax.device_put(jnp.asarray(lq), vec),
-            jax.device_put(jnp.asarray(lt), vec), B)
+    t0 = time.perf_counter()
+    out = (jax.device_put(jnp.asarray(q), row),
+           jax.device_put(jnp.asarray(t), row),
+           jax.device_put(jnp.asarray(lq), vec),
+           jax.device_put(jnp.asarray(lt), vec), B)
+    record_h2d(q.nbytes + t.nbytes + lq.nbytes + lt.nbytes,
+               time.perf_counter() - t0, name="h2d/align")
+    return out
 
 
 def nw_align_batch_sharded(mesh: Mesh, q: np.ndarray, t: np.ndarray,
@@ -97,7 +103,11 @@ def nw_align_batch_sharded(mesh: Mesh, q: np.ndarray, t: np.ndarray,
     with mesh:
         ops, n = nw_align_batch(qd, td, lqd, ltd, match=match,
                                 mismatch=mismatch, gap=gap)
-    return np.asarray(ops)[:B], np.asarray(n)[:B]
+    t0 = time.perf_counter()
+    ops_h, n_h = np.asarray(ops), np.asarray(n)
+    record_d2h(ops_h.nbytes + n_h.nbytes, time.perf_counter() - t0,
+               name="d2h/align")
+    return ops_h[:B], n_h[:B]
 
 
 def _sp_forward(sp, nsp, jglob, qv, tv, a, *, match, mismatch, gap,
@@ -198,7 +208,10 @@ def sp_nw_scores(mesh: Mesh, q: np.ndarray, t: np.ndarray, lq: np.ndarray,
     qd, td, lqd, ltd, B = shard_align_inputs(mesh, q, t, lq, lt)
     out = _sp_scores_jit(qd, td, lqd, ltd, match=match, mismatch=mismatch,
                          gap=gap, mesh=mesh)
-    return np.asarray(out)[:B]
+    t0 = time.perf_counter()
+    out_h = np.asarray(out)
+    record_d2h(out_h.nbytes, time.perf_counter() - t0, name="d2h/sp")
+    return out_h[:B]
 
 
 @functools.partial(jax.jit,
@@ -300,8 +313,13 @@ def sp_nw_align(mesh: Mesh, q: np.ndarray, t: np.ndarray, lq: np.ndarray,
     ops, n = _sp_align_jit(qd, td, lqd, ltd, match=match,
                            mismatch=mismatch, gap=gap, mesh=mesh)
     W = ops.shape[1]
-    ops_h = np.asarray(ops)[:B]
-    n_h = np.asarray(n)[:B]
+    t0 = time.perf_counter()
+    ops_h = np.asarray(ops)
+    n_h = np.asarray(n)
+    record_d2h(ops_h.nbytes + n_h.nbytes, time.perf_counter() - t0,
+               name="d2h/sp")
+    ops_h = ops_h[:B]
+    n_h = n_h[:B]
     # Re-right-align to Lq+Lt width if target padding widened the walk.
     want = q.shape[1] + Lt
     if W != want:

@@ -35,6 +35,9 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from racon_tpu.obs.metrics import (record_flag_pull, record_h2d,
+                                   registry as obs_registry)
+from racon_tpu.obs.trace import get_tracer
 from racon_tpu.sched.repack import RepackPlan
 from racon_tpu.sched.telemetry import SchedTelemetry
 
@@ -90,11 +93,20 @@ class ConvergenceScheduler:
         """
         import jax
         job_h, win_h = plan.packed_bufs()
+        t0 = time.perf_counter()
         if self.mesh is None:
-            return tuple(jax.device_put((job_h, win_h)))
-        from jax.sharding import NamedSharding, PartitionSpec as P
-        return (jax.device_put(job_h, NamedSharding(self.mesh, P("dp"))),
-                jax.device_put(win_h, NamedSharding(self.mesh, P())))
+            bufs = tuple(jax.device_put((job_h, win_h)))
+        else:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            bufs = (jax.device_put(job_h,
+                                   NamedSharding(self.mesh, P("dp"))),
+                    jax.device_put(win_h, NamedSharding(self.mesh, P())))
+        # device_put is async here by design (the transfer overlaps the
+        # previous chunk's rounds): the recorded seconds cover only the
+        # synchronous serialization/enqueue portion.
+        record_h2d(job_h.nbytes + win_h.nbytes, time.perf_counter() - t0,
+                   name="h2d/chunk")
+        return bufs
 
     # ------------------------------------------------------------------ run
 
@@ -127,9 +139,12 @@ class ConvergenceScheduler:
         if bufs is None:
             bufs = self.put_chunk(plan)
         job_buf, win_buf = bufs
+        tracer = get_tracer()
+        reg = obs_registry()
         (bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf,
          out_codes, out_cov, out_total, out_ovf) = sched_unpack(
             job_buf, win_buf, Lq=plan.Lq, LA=plan.LA, n_win=plan.n_win)
+        reg.inc("device_dispatches")
 
         n_real = plan.n_real_win
         telem.record_chunk(n_real)
@@ -145,22 +160,31 @@ class ConvergenceScheduler:
         pallas = _use_pallas(plan.B // ndp, plan.Lq, plan.LA)
         for r in range(pre):
             telem.record_round(r, n_real)
-        (bb, bbw, alen, begin, end, ovf, conv, out_codes, out_cov,
-         out_total, out_ovf) = sched_rounds(
-            bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf,
-            out_codes, out_cov, out_total, out_ovf, orig_ids, pre == R,
-            n_win=plan.n_win, pallas=pallas,
-            band_ws=tuple(round_band_width(band_w, r) for r in range(pre)),
-            detect=R >= 2, **statics)
+        with tracer.span("round", f"rounds0-{pre - 1}", lanes=plan.B,
+                         windows=n_real):
+            (bb, bbw, alen, begin, end, ovf, conv, out_codes, out_cov,
+             out_total, out_ovf) = sched_rounds(
+                bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf,
+                out_codes, out_cov, out_total, out_ovf, orig_ids, pre == R,
+                n_win=plan.n_win, pallas=pallas,
+                band_ws=tuple(round_band_width(band_w, r)
+                              for r in range(pre)),
+                detect=R >= 2, **statics)
+        reg.inc("device_dispatches")
         executed = pre
 
         n_alive = n_real
         cur_B, cur_nwin = plan.B, plan.n_win
         while executed < R and n_alive > 0:
             # The only per-round d2h: two bool vectors for control flow
-            # (they feed telemetry for free).
+            # (they feed telemetry for free). This pull is the sync
+            # point, so its time (compute wait + tunnel round-trip) is
+            # accounted separately from the transfer bandwidth keys.
+            t_pull = time.perf_counter()
             conv_h = np.asarray(conv)
             ovf_h = np.asarray(ovf)
+            record_flag_pull(conv_h.nbytes + ovf_h.nbytes,
+                             time.perf_counter() - t_pull)
             frozen = real & (conv_h | ovf_h)
             telem.record_freeze(executed, int(frozen.sum()))
             surv = real & ~conv_h & ~ovf_h
@@ -186,20 +210,25 @@ class ConvergenceScheduler:
             if B2 >= cur_B and 2 * nw2 > cur_nwin:
                 for r in range(executed, R):
                     telem.record_round(r, n_alive)
-                (bb, bbw, alen, begin, end, ovf, conv, out_codes,
-                 out_cov, out_total, out_ovf) = sched_rounds(
-                    bb, bbw, alen, begin, end, q, qw8, lq, w_read, win,
-                    ovf, out_codes, out_cov, out_total, out_ovf,
-                    orig_ids, True, n_win=cur_nwin, pallas=pallas,
-                    band_ws=tuple(round_band_width(band_w, r)
-                                  for r in range(executed, R)),
-                    detect=False, **statics)
+                with tracer.span("round", f"rounds{executed}-{R - 1}",
+                                 lanes=cur_B, windows=n_alive,
+                                 fused_tail=1):
+                    (bb, bbw, alen, begin, end, ovf, conv, out_codes,
+                     out_cov, out_total, out_ovf) = sched_rounds(
+                        bb, bbw, alen, begin, end, q, qw8, lq, w_read,
+                        win, ovf, out_codes, out_cov, out_total, out_ovf,
+                        orig_ids, True, n_win=cur_nwin, pallas=pallas,
+                        band_ws=tuple(round_band_width(band_w, r)
+                                      for r in range(executed, R)),
+                        detect=False, **statics)
+                reg.inc("device_dispatches")
                 executed = R
                 break
 
             t0 = time.perf_counter()
             rp = RepackPlan(surv, cur_win_h, cur_orig, trash=trash,
                             n_shards=ndp)
+            t_put = time.perf_counter()
             if self.mesh is None:
                 lane_idx_d, new_win_d, win_map_d, win_real_d = \
                     jax.device_put((rp.lane_idx, rp.new_win, rp.win_map,
@@ -212,10 +241,16 @@ class ConvergenceScheduler:
                 win_real_d = jax.device_put(rp.win_real, rep)
                 new_win_d = jax.device_put(
                     rp.new_win, NamedSharding(self.mesh, P("dp")))
-            (bb, bbw, alen, begin, end, q, qw8, lq, w_read, ovf) = \
-                sched_repack(bb, bbw, alen, begin, end, q, qw8, lq,
-                             w_read, ovf, lane_idx_d, new_win_d,
-                             win_map_d, win_real_d, mesh=self.mesh)
+            record_h2d(rp.lane_idx.nbytes + rp.new_win.nbytes +
+                       rp.win_map.nbytes + rp.win_real.nbytes,
+                       time.perf_counter() - t_put, name="h2d/repack")
+            with tracer.span("dispatch", "repack", lanes=rp.B,
+                             windows=n_alive):
+                (bb, bbw, alen, begin, end, q, qw8, lq, w_read, ovf) = \
+                    sched_repack(bb, bbw, alen, begin, end, q, qw8, lq,
+                                 w_read, ovf, lane_idx_d, new_win_d,
+                                 win_map_d, win_real_d, mesh=self.mesh)
+            reg.inc("device_dispatches")
             win = new_win_d
             cur_win_h = rp.new_win
             cur_orig = rp.orig_ids
@@ -226,13 +261,16 @@ class ConvergenceScheduler:
 
             telem.record_round(executed, n_alive)
             pallas = _use_pallas(rp.B // ndp, plan.Lq, plan.LA)
-            (bb, bbw, alen, begin, end, ovf, conv, out_codes, out_cov,
-             out_total, out_ovf) = sched_rounds(
-                bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf,
-                out_codes, out_cov, out_total, out_ovf, orig_ids,
-                executed == R - 1, n_win=rp.n_win, pallas=pallas,
-                band_ws=(round_band_width(band_w, executed),),
-                detect=True, **statics)
+            with tracer.span("round", f"round{executed}", lanes=rp.B,
+                             windows=n_alive):
+                (bb, bbw, alen, begin, end, ovf, conv, out_codes, out_cov,
+                 out_total, out_ovf) = sched_rounds(
+                    bb, bbw, alen, begin, end, q, qw8, lq, w_read, win,
+                    ovf, out_codes, out_cov, out_total, out_ovf, orig_ids,
+                    executed == R - 1, n_win=rp.n_win, pallas=pallas,
+                    band_ws=(round_band_width(band_w, executed),),
+                    detect=True, **statics)
+            reg.inc("device_dispatches")
             executed += 1
 
         if n_alive > 0:
@@ -240,6 +278,7 @@ class ConvergenceScheduler:
             telem.record_freeze(R, n_alive)
 
         packed = sched_pack(out_codes, out_cov, out_total, out_ovf)
+        reg.inc("device_dispatches")
         if stats is not None:
             stats["chunks"] = stats.get("chunks", 0) + 1
             stats["_t_pack"] = time.perf_counter()

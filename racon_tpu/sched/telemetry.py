@@ -2,9 +2,11 @@
 
 Counters only — every value is fed from flags the scheduler already
 pulls to the host for control flow, so recording costs no extra device
-syncs. The polisher prints :meth:`SchedTelemetry.summary` through
-utils/logger.py and bench.py serializes :meth:`as_extras` into its JSON
-extras (keys documented in docs/SCHEDULER.md).
+syncs. Reporting routes through the metrics registry
+(racon_tpu/obs/metrics.py): ``publish_sched`` writes the canonical
+``sched_*`` keys the polisher's stderr summary and bench.py's extras
+both read, so the serialized and printed views cannot drift (keys
+documented in docs/SCHEDULER.md and docs/OBSERVABILITY.md).
 """
 
 from __future__ import annotations
@@ -75,24 +77,17 @@ class SchedTelemetry:
         return 1.0 - executed / (self.windows * self.rounds)
 
     def as_extras(self) -> Dict[str, object]:
-        """JSON-serializable counters for bench.py extras."""
-        return {
-            "sched_rounds": self.rounds,
-            "sched_windows": self.windows,
-            "sched_chunks": self.chunks,
-            "sched_rounds_hist": {str(k): v
-                                  for k, v in sorted(self.hist.items())},
-            "sched_survivor_frac": [round(f, 4)
-                                    for f in self.survivor_frac()],
-            "sched_rounds_saved_frac": round(self.rounds_saved_frac(), 4),
-            "sched_repack_overhead_s": round(self.repack_s, 4),
-            "sched_dispatches_saved": self.dispatches_saved,
-        }
+        """JSON-serializable counters (the registry's sched_* keys)."""
+        from racon_tpu.obs.metrics import (MetricsRegistry, publish_sched,
+                                           sched_extras)
+        reg = MetricsRegistry()
+        publish_sched(self, reg)
+        return sched_extras(reg)
 
     def summary(self) -> str:
-        """One line for the polisher's stderr log."""
-        hist = " ".join(f"r{k}:{v}" for k, v in sorted(self.hist.items()))
-        return (f"windows={self.windows} chunks={self.chunks} "
-                f"frozen[{hist}] "
-                f"rounds_saved={self.rounds_saved_frac():.0%} "
-                f"repack={self.repack_s:.3f}s")
+        """One line for the polisher's stderr log (registry-formatted)."""
+        from racon_tpu.obs.metrics import (MetricsRegistry, publish_sched,
+                                           sched_summary_line)
+        reg = MetricsRegistry()
+        publish_sched(self, reg)
+        return sched_summary_line(reg)

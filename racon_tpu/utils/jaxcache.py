@@ -9,17 +9,34 @@ entirely (measured round 5: a small consensus run dropped 44.5 s ->
 
 Opt out with RACON_TPU_JAX_CACHE=0; point elsewhere with
 RACON_TPU_JAX_CACHE=/path.
+
+Cache population is also the observability layer's compile accounting
+(racon_tpu/obs/metrics.py): enabling records the entry count at start,
+and :func:`cache_extras` reports entries added since — every added
+entry is a compile this process paid for (a warm run adds none).
 """
 
 from __future__ import annotations
 
 import os
 
+from racon_tpu.obs.metrics import registry as _obs_registry
+
+
+def cache_entry_count(path: str) -> int:
+    """Number of serialized executables currently in the cache dir."""
+    try:
+        return sum(1 for e in os.scandir(path) if e.is_file())
+    except OSError:
+        return 0
+
 
 def enable_compile_cache(path: str | None = None) -> None:
     """Enable the cache (idempotent, safe before or after jax import)."""
     env = os.environ.get("RACON_TPU_JAX_CACHE", "")
+    reg = _obs_registry()
     if env in ("0", "false", "off"):
+        reg.set("jax_cache_enabled", 0)
         return
     path = path or env or os.path.expanduser("~/.cache/racon_tpu/jax")
     try:
@@ -28,6 +45,23 @@ def enable_compile_cache(path: str | None = None) -> None:
         jax.config.update("jax_compilation_cache_dir", path)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+        reg.set("jax_cache_enabled", 1)
+        reg.set("_jax_cache_dir", path)
+        reg.set("jax_cache_entries_start", cache_entry_count(path))
     except Exception:
         # Cache is an optimization; never fail a run over it.
-        pass
+        reg.set("jax_cache_enabled", 0)
+
+
+def cache_extras(reg=None) -> dict:
+    """Compile-cache counters for bench extras: entries at enable time
+    and entries added since (~= fresh compiles this process)."""
+    reg = reg if reg is not None else _obs_registry()
+    out = {"jax_cache_enabled": int(reg.get("jax_cache_enabled", 0))}
+    path = reg.get("_jax_cache_dir", "")
+    if out["jax_cache_enabled"] and path:
+        start = int(reg.get("jax_cache_entries_start", 0))
+        out["jax_cache_entries_start"] = start
+        out["jax_cache_entries_added"] = max(
+            cache_entry_count(str(path)) - start, 0)
+    return out

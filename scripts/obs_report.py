@@ -1,0 +1,202 @@
+"""Render a racon_tpu JSONL trace into a per-stage breakdown table.
+
+The manual workflow this automates: PROFILE.md's delta tables were
+hand-assembled from RACON_TPU_TIMING stderr lines and stopwatch
+arithmetic every perf round. A trace (RACON_TPU_TRACE=<path> or
+``--trace``) now carries the same decomposition; this script renders it.
+
+Usage:
+    python scripts/obs_report.py TRACE.jsonl            # breakdown table
+    python scripts/obs_report.py TRACE.jsonl --validate # schema check
+
+``--validate`` exits non-zero unless the trace is well-formed: a begin
+header, JSON-parseable lines, required span keys, non-negative timings,
+parents that exist, and children contained in their parent's interval
+(the contract documented in docs/OBSERVABILITY.md; ci.sh gates it).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Dict, List, Optional
+
+REQUIRED_SPAN_KEYS = ("id", "parent", "kind", "name", "t0", "dur_s")
+
+# Span intervals are rounded to 1e-6 on write and a parent's clock stops
+# fractionally after its children's; allow that much slack in nesting.
+EPS = 5e-3
+
+
+class TraceError(ValueError):
+    pass
+
+
+def load_trace(path: str) -> Dict[str, object]:
+    """Parse a trace file into {begin, spans (by id), metrics}."""
+    begin = None
+    metrics = None
+    spans: Dict[int, dict] = {}
+    with open(path, "r", encoding="utf-8") as fh:
+        for ln, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError as exc:
+                raise TraceError(f"line {ln}: not valid JSON ({exc})")
+            ev = obj.get("ev")
+            if ev == "begin":
+                begin = obj
+            elif ev == "span":
+                spans[obj.get("id")] = obj
+            elif ev == "metrics":
+                metrics = obj
+            elif ev is None:
+                raise TraceError(f"line {ln}: missing 'ev' key")
+    return {"begin": begin, "spans": spans, "metrics": metrics}
+
+
+def validate(tr: Dict[str, object]) -> List[str]:
+    """Return a list of schema violations (empty = valid)."""
+    errs: List[str] = []
+    if tr["begin"] is None:
+        errs.append("no begin header")
+    elif tr["begin"].get("schema") != 1:
+        errs.append(f"unknown schema {tr['begin'].get('schema')!r}")
+    spans: Dict[int, dict] = tr["spans"]
+    for sid, s in spans.items():
+        for k in REQUIRED_SPAN_KEYS:
+            if k not in s:
+                errs.append(f"span {sid}: missing key {k!r}")
+        if not isinstance(s.get("id"), int):
+            errs.append(f"span {sid}: non-integer id")
+        for k in ("t0", "dur_s"):
+            v = s.get(k)
+            if not isinstance(v, (int, float)) or v < 0:
+                errs.append(f"span {sid}: {k} must be a non-negative "
+                            f"number, got {v!r}")
+        parent = s.get("parent")
+        if parent is not None:
+            p = spans.get(parent)
+            if p is None:
+                errs.append(f"span {sid}: parent {parent} not in trace")
+            else:
+                if s["t0"] < p["t0"] - EPS:
+                    errs.append(f"span {sid}: starts before parent "
+                                f"{parent}")
+                if s["t0"] + s["dur_s"] > \
+                        p["t0"] + p["dur_s"] + EPS:
+                    errs.append(f"span {sid}: ends after parent {parent}")
+    return errs
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KB", "MB", "GB"):
+        if abs(n) < 1024 or unit == "GB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024
+    return f"{n:.1f} GB"
+
+
+def _agg(rows: List[dict]):
+    total = sum(s["dur_s"] for s in rows)
+    return len(rows), total
+
+
+def render(tr: Dict[str, object], out=sys.stdout) -> None:
+    """Print the per-stage breakdown (the PROFILE.md table, automated)."""
+    spans: Dict[int, dict] = tr["spans"]
+    if not spans:
+        print("(empty trace: no spans)", file=out)
+        return
+    runs = [s for s in spans.values() if s["kind"] == "run"]
+    wall = max((s["t0"] + s["dur_s"] for s in spans.values()))
+    run_dur = runs[0]["dur_s"] if runs else wall
+    base = runs[0]["name"] if runs else "(no run span)"
+    print(f"run: {base}  wall={run_dur:.3f}s  spans={len(spans)}",
+          file=out)
+
+    # Per-kind > per-name aggregation, phases in time order.
+    by_kind: Dict[str, List[dict]] = {}
+    for s in spans.values():
+        by_kind.setdefault(s["kind"], []).append(s)
+
+    for kind in ("phase", "chunk", "round", "dispatch"):
+        rows = by_kind.get(kind)
+        if not rows:
+            continue
+        print(f"\n{kind:>8}  {'count':>5}  {'total_s':>9}  {'%run':>6}"
+              f"  name", file=out)
+        by_name: Dict[str, List[dict]] = {}
+        for s in sorted(rows, key=lambda s: s["t0"]):
+            by_name.setdefault(s["name"], []).append(s)
+        for name, group in by_name.items():
+            n, tot = _agg(group)
+            pct = 100.0 * tot / run_dur if run_dur else 0.0
+            print(f"{'':>8}  {n:>5}  {tot:>9.3f}  {pct:>5.1f}%  {name}",
+                  file=out)
+
+    transfers = by_kind.get("transfer", [])
+    if transfers:
+        print(f"\ntransfer  {'count':>5}  {'total_s':>9}  {'bytes':>10}"
+              f"  {'MB/s':>8}  dir", file=out)
+        for d in ("h2d", "d2h"):
+            rows = [s for s in transfers if s.get("dir") == d]
+            if not rows:
+                continue
+            n, tot = _agg(rows)
+            nb = sum(s.get("bytes", 0) for s in rows)
+            bw = nb / tot / 1e6 if tot > 0 else 0.0
+            print(f"{'':>8}  {n:>5}  {tot:>9.3f}  {_fmt_bytes(nb):>10}"
+                  f"  {bw:>8.3f}  {d}", file=out)
+
+    # Coverage: how much of the run the traced stages account for. The
+    # phase spans partition the run's wall clock (chunk/round/dispatch
+    # spans nest inside them and would double-count); without phases,
+    # fall back to direct children of the run span.
+    if runs:
+        rows = by_kind.get("phase") or [
+            s for s in spans.values() if s.get("parent") == runs[0]["id"]]
+        cov = sum(s["dur_s"] for s in rows)
+        pct = 100.0 * cov / run_dur if run_dur else 0.0
+        print(f"\ncoverage: traced stages sum {cov:.3f}s = {pct:.1f}% "
+              f"of run wall", file=out)
+
+    m = tr["metrics"]
+    if m:
+        keys = [k for k in sorted(m) if k != "ev"]
+        print("\nmetrics:", file=out)
+        for k in keys:
+            print(f"  {k} = {m[k]}", file=out)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    do_validate = "--validate" in argv
+    paths = [a for a in argv if not a.startswith("--")]
+    if len(paths) != 1:
+        print("usage: obs_report.py TRACE.jsonl [--validate]",
+              file=sys.stderr)
+        return 2
+    try:
+        tr = load_trace(paths[0])
+    except (OSError, TraceError) as exc:
+        print(f"[obs_report] error: {exc}", file=sys.stderr)
+        return 1
+    if do_validate:
+        errs = validate(tr)
+        if errs:
+            for e in errs:
+                print(f"[obs_report] invalid: {e}", file=sys.stderr)
+            return 1
+        print(f"[obs_report] valid: {len(tr['spans'])} spans, "
+              f"schema {tr['begin'].get('schema')}")
+        return 0
+    render(tr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

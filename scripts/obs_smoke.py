@@ -1,0 +1,97 @@
+"""CI smoke: tiny polish with tracing on, then validate the trace.
+
+Runs the real CLI path (create_polisher -> polish -> FASTA out) on a
+synthetic contig with --trace enabled, then checks the emitted JSONL
+against the documented schema (scripts/obs_report.py --validate logic:
+required keys, span nesting containment, non-negative timings) and
+renders the breakdown table once so a formatting regression fails CI
+rather than the next perf investigation.
+"""
+
+import contextlib
+import io
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np                                   # noqa: E402
+
+from racon_tpu import cli                            # noqa: E402
+from scripts import obs_report                       # noqa: E402
+
+BASES = np.frombuffer(b"ACGT", np.uint8)
+
+
+def _noisy(rng, truth):
+    out = []
+    for b in truth:
+        r = rng.random()
+        if r < 0.03:
+            continue
+        out.append(int(rng.integers(0, 4)) if r < 0.06 else int(
+            np.searchsorted(BASES, b)))
+    return bytes(BASES[np.array(out)])
+
+
+def _write_inputs(d):
+    rng = np.random.default_rng(11)
+    truth = BASES[rng.integers(0, 4, 400)]
+    draft = _noisy(rng, truth)
+    with open(os.path.join(d, "draft.fasta"), "wb") as fh:
+        fh.write(b">c1\n" + draft + b"\n")
+    reads, paf = [], []
+    for i in range(8):
+        r = _noisy(rng, truth)
+        reads.append(b">r%d\n%s\n" % (i, r))
+        paf.append(f"r{i}\t{len(r)}\t0\t{len(r)}\t+\tc1\t{len(draft)}\t0"
+                   f"\t{len(draft)}\t{min(len(r), len(draft))}"
+                   f"\t{max(len(r), len(draft))}\t60")
+    with open(os.path.join(d, "reads.fasta"), "wb") as fh:
+        fh.write(b"".join(reads))
+    with open(os.path.join(d, "ovl.paf"), "w") as fh:
+        fh.write("\n".join(paf) + "\n")
+    return d
+
+
+def main():
+    with tempfile.TemporaryDirectory() as d:
+        _write_inputs(d)
+        trace = os.path.join(d, "trace.jsonl")
+        # Exercise the env-var path (--trace covers the same configure()
+        # call; tests/test_obs.py exercises the explicit-path form).
+        os.environ["RACON_TPU_TRACE"] = trace
+
+        # cli.main writes FASTA to sys.stdout.buffer; run it captured so
+        # the smoke's own output stays readable.
+        class _Capture(io.StringIO):
+            buffer = io.BytesIO()
+
+        stdout = _Capture()
+        buf = stdout.buffer
+        with contextlib.redirect_stdout(stdout):
+            rc = cli.main(["--backend", "jax",
+                           os.path.join(d, "reads.fasta"),
+                           os.path.join(d, "ovl.paf"),
+                           os.path.join(d, "draft.fasta")])
+        assert rc == 0, f"cli exited {rc}"
+        assert buf.getvalue().startswith(b">c1 LN:i:"), "no polished FASTA"
+
+        tr = obs_report.load_trace(trace)
+        errs = obs_report.validate(tr)
+        assert not errs, "trace schema violations:\n" + "\n".join(errs)
+        spans = tr["spans"]
+        kinds = {s["kind"] for s in spans.values()}
+        for want in ("run", "phase", "chunk"):
+            assert want in kinds, f"no {want!r} span in trace ({kinds})"
+        assert tr["metrics"] is not None, "no metrics footer"
+        assert tr["metrics"].get("h2d_bytes", 0) > 0, "no h2d accounting"
+        print(f"[obs-smoke] trace ok: {len(spans)} spans, kinds={sorted(kinds)}",
+              flush=True)
+        obs_report.render(tr)
+    print("[obs-smoke] PASS", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,116 @@
+"""Logger formatting/behavior tests (reference logger parity plus the
+non-TTY fallback — utils/logger.py)."""
+
+import io
+import re
+
+from racon_tpu.utils.logger import Logger, NullLogger
+
+
+class _Tty(io.StringIO):
+    def isatty(self):
+        return True
+
+
+def test_phase_format():
+    s = io.StringIO()
+    log = Logger(stream=s)
+    log.begin()
+    log.phase("[x] loaded")
+    out = s.getvalue()
+    assert re.fullmatch(r"\[x\] loaded \d+\.\d{6} s\n", out), out
+
+
+def test_total_format():
+    s = io.StringIO()
+    log = Logger(stream=s)
+    log.total("[x] total =")
+    assert re.fullmatch(r"\[x\] total = \d+\.\d{6} s\n", s.getvalue())
+
+
+def test_tick_tty_redraws_with_cr():
+    s = _Tty()
+    log = Logger(stream=s)
+    log.begin()
+    log.tick("[x] working")
+    log.tick("[x] working")
+    out = s.getvalue()
+    # Carriage-return redraw, no newline until the bar completes.
+    assert out.count("\r") == 2
+    assert "\n" not in out
+    assert "[==                  ]" in out
+
+
+def test_tick_tty_bar_completes_with_newline():
+    s = _Tty()
+    log = Logger(stream=s)
+    log.begin()
+    for _ in range(20):
+        log.tick("[x] working")
+    out = s.getvalue()
+    assert out.endswith("s\n")
+    assert "[====================]" in out
+
+
+def test_tick_non_tty_plain_lines():
+    """Non-TTY stderr (log files, CI pipes): one complete line per tick,
+    no '\\r' anywhere — a redrawn bar in a log is one garbled mega-line."""
+    s = io.StringIO()
+    log = Logger(stream=s)
+    log.begin()
+    for _ in range(3):
+        log.tick("[x] working")
+    out = s.getvalue()
+    assert "\r" not in out
+    lines = out.splitlines()
+    assert len(lines) == 3
+    assert "[=                   ]" in lines[0]
+    assert "[===                 ]" in lines[2]
+
+
+def test_phase_closes_partial_tty_bar():
+    """A phase print after a partial bar must start on a fresh line."""
+    s = _Tty()
+    log = Logger(stream=s)
+    log.begin()
+    log.tick("[x] working")
+    log.phase("[x] done")
+    out = s.getvalue()
+    # The partial bar line is terminated before the phase line prints.
+    assert "\n[x] done" in out
+
+
+def test_line_closes_partial_tty_bar():
+    s = _Tty()
+    log = Logger(stream=s)
+    log.begin()
+    log.tick("[x] working")
+    log.line("[x] diagnostic")
+    assert "\n[x] diagnostic\n" in s.getvalue()
+
+
+def test_bar_resets_per_phase():
+    s = io.StringIO()
+    log = Logger(stream=s)
+    log.begin()
+    for _ in range(5):
+        log.tick("[x] a")
+    log.phase("[x] a done")
+    log.begin()
+    log.tick("[x] b")
+    # New phase's bar starts from one '=' again.
+    assert "[=                   ]" in s.getvalue().splitlines()[-1]
+
+
+def test_null_logger_is_silent_and_safe():
+    log = NullLogger()
+    log.begin()
+    log.phase("msg")
+    for _ in range(25):
+        log.tick("msg")
+    log.line("msg")
+    log.total("msg")
+    # Its stream is inert (never a real fd) and reports non-TTY.
+    assert log.stream.isatty() is False
+    assert log.stream.write("x") == 1
+    log.stream.flush()
