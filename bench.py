@@ -130,6 +130,29 @@ def main():
     assert n_changed > n_windows * 0.9, "consensus did not polish"
 
     e2e = n_windows / dt
+
+    # Streamed end-to-end: the same workload through the streaming
+    # executor (racon_tpu/pipeline/ — build/pack/h2d/compute stage
+    # threads, depth-2 double buffering). Output must be bit-identical
+    # to the serial run; the rate and the pipe_* gauges (stage busy /
+    # stall, queue peaks, overlap efficiency) ride along as extras.
+    pwindows = build_windows(n_windows, coverage, wlen)
+    peng = PoaEngine(backend=backend)
+    obs_metrics.reset()
+    t0 = time.perf_counter()
+    with tracer.span("run", "bench_e2e_pipelined", n_windows=n_windows):
+        from racon_tpu.pipeline.streaming import stream_consensus
+        covered = 0
+        for s, e in stream_consensus(peng, pwindows, depth=2):
+            covered += e - s
+    dt_pipe = time.perf_counter() - t0
+    assert covered == n_windows
+    assert [w.consensus for w in pwindows] == \
+        [w.consensus for w in windows], \
+        "pipelined consensus diverged from serial"
+    e2e_pipe = n_windows / dt_pipe
+    pipe_extras = obs_metrics.pipeline_extras()
+
     # Compute-only: time one warm production chunk with chained reps.
     # When the convergence scheduler is on (the default), the production
     # chunk program IS the scheduler's dispatch chain (racon_tpu/sched/)
@@ -192,21 +215,24 @@ def main():
     # reflects the tunnel-fed rate while compute-only is the chip rate;
     # both are reported.
     from racon_tpu.utils.jaxcache import cache_extras
-    extras = {**sched_extras, **e2e_transfers, **cache_extras()}
+    extras = {**sched_extras, **e2e_transfers, **pipe_extras,
+              **cache_extras()}
     out = {
-        # metric_version 3: same primary value as version 2 (compute-only
-        # windows/s of a warm production chunk — the convergence
-        # scheduler's dispatch chain when RACON_TPU_SCHED is on, the
-        # default, else the fixed fused dispatch), with extras now
-        # sourced from the obs metrics registry: e2e_h2d_* / e2e_d2h_*
-        # transfer accounting (bytes, seconds, effective bandwidth of
-        # the measured e2e run), dispatch counts, and compile-cache
-        # population. Version 1 (rounds <= 5) timed the fixed fused
+        # metric_version 4: same primary value as versions 2/3
+        # (compute-only windows/s of a warm production chunk — the
+        # convergence scheduler's dispatch chain when RACON_TPU_SCHED is
+        # on, the default, else the fixed fused dispatch). New in 4: the
+        # same workload also runs through the streaming executor
+        # (racon_tpu/pipeline/), asserted bit-identical to the serial
+        # run, reported as e2e_pipelined_windows_per_sec with the pipe_*
+        # stage/queue gauges and pipe_overlap_efficiency as extras.
+        # Version 3 added registry-sourced e2e_h2d_*/e2e_d2h_* transfer
+        # accounting; version 1 (rounds <= 5) timed the fixed fused
         # dispatch only — that series continues under
         # fixed_engine_windows_per_sec. Bump this whenever the primary
         # value's definition changes, so round-over-round comparisons
         # can't silently mix metrics.
-        "metric_version": 3,
+        "metric_version": 4,
         "metric": f"POA windows/sec/chip, compute-only (direct-timed warm "
                   f"production chunk, convergence-scheduled refinement "
                   f"rounds — racon_tpu/sched/, telemetry in sched_* "
@@ -215,7 +241,8 @@ def main():
                   "MEASURED 64-thread-idealized native CPU anchor "
                   f"{CPU_64T_WINDOWS_PER_SEC:.1f} w/s; chunk-pipelined "
                   "end-to-end rate through this env's 1.4-7 MB/s tunnel "
-                  "in e2e_* extras)",
+                  "in e2e_* extras, streaming-pipeline rate in "
+                  "e2e_pipelined_* / pipe_* extras)",
         "value": round(compute, 2),
         "unit": "windows/s",
         "vs_baseline": round(compute / CPU_64T_WINDOWS_PER_SEC, 3),
@@ -227,6 +254,9 @@ def main():
                                           CPU_64T_WINDOWS_PER_SEC, 3),
         "e2e_windows_per_sec": round(e2e, 2),
         "e2e_vs_baseline": round(e2e / CPU_64T_WINDOWS_PER_SEC, 3),
+        "e2e_pipelined_windows_per_sec": round(e2e_pipe, 2),
+        "e2e_pipelined_vs_baseline": round(
+            e2e_pipe / CPU_64T_WINDOWS_PER_SEC, 3),
         "cpu_anchor_1t_measured": CPU_1T_MEASURED,
         "vs_ref_spoa_64t_est": round(compute / CPU_64T_REF_SPOA_EST, 3),
         "n_windows": n_windows,

@@ -29,6 +29,9 @@ else
   echo "[ci] scheduler differential golden (sched vs fixed, SAM+FASTQ)"
   python -m pytest tests/test_polisher.py -q -m '' \
     -k "test_sched_differential_golden and sam_fastq"
+  echo "[ci] pipeline differential golden (streamed vs serial, SAM+FASTQ)"
+  python -m pytest tests/test_pipeline.py -q -m '' \
+    -k "test_pipeline_differential_golden and sam_fastq"
 fi
 
 echo "[ci] multi-chip dryrun (8 virtual devices)"
@@ -39,5 +42,8 @@ python scripts/two_shape_smoke.py
 
 echo "[ci] observability smoke (traced tiny polish + JSONL schema gate)"
 python scripts/obs_smoke.py
+
+echo "[ci] pipeline smoke (streamed == serial FASTA + pipe span/gauge gate)"
+python scripts/pipeline_smoke.py
 
 echo "[ci] OK"

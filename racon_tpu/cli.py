@@ -72,6 +72,13 @@ def build_parser() -> argparse.ArgumentParser:
                          "(coordinator/process env auto-detected on TPU "
                          "pods) before building the device mesh; combine "
                          "with --dp <total devices>")
+    ap.add_argument("--pipeline-depth", type=int, default=None,
+                    metavar="N",
+                    help="default: unset (RACON_TPU_PIPELINE decides); "
+                         "N>0 enables the streaming execution pipeline "
+                         "with N in-flight chunks per stage (2 = double "
+                         "buffering), 0 forces the serial path (see "
+                         "docs/PIPELINE.md)")
     ap.add_argument("--trace", metavar="PATH", default=None,
                     help="write a structured JSONL run trace to PATH "
                          "(same as RACON_TPU_TRACE=PATH; render with "
@@ -108,7 +115,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     from racon_tpu.models.overlap import PolisherError
     from racon_tpu.io.parsers import ParseError
     from racon_tpu.models.polisher import PolisherType, create_polisher
+    from racon_tpu.pipeline import configure as configure_pipeline
+    from racon_tpu.pipeline import pipeline_enabled
     from racon_tpu.utils.logger import Logger
+
+    try:
+        configure_pipeline(args.pipeline_depth)
+    except ValueError as exc:
+        print(str(exc), file=sys.stderr)
+        return 1
 
     logger = Logger()
     mesh = None
@@ -142,6 +157,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         from jax.sharding import Mesh
         mesh = Mesh(_np.asarray(devs[:ndp]), ("dp",))
 
+    out = sys.stdout.buffer
     try:
         with tracer.span("run", "racon_tpu"):
             polisher = create_polisher(
@@ -153,20 +169,30 @@ def main(argv: Optional[List[str]] = None) -> int:
                 backend=args.backend, logger=logger, threads=args.threads,
                 mesh=mesh)
             polisher.initialize()
-            polished = polisher.polish(not args.include_unpolished)
+            if pipeline_enabled():
+                # Streaming path: each contig is written the moment its
+                # last window retires, while later windows still flow
+                # through the pipeline — emission overlaps compute.
+                for seq in polisher.polish_stream(
+                        not args.include_unpolished):
+                    out.write(b">" + seq.name.encode() + b"\n" +
+                              seq.data + b"\n")
+            else:
+                for seq in polisher.polish(not args.include_unpolished):
+                    out.write(b">" + seq.name.encode() + b"\n" +
+                              seq.data + b"\n")
     except (PolisherError, ParseError, ValueError) as exc:
         print(str(exc), file=sys.stderr)
         return 1
-
-    out = sys.stdout.buffer
-    for seq in polished:
-        out.write(b">" + seq.name.encode() + b"\n" + seq.data + b"\n")
     out.flush()
     logger.total("[racon_tpu::Polisher::] total =")
+    from racon_tpu.obs.metrics import pipeline_extras
     from racon_tpu.obs.metrics import registry as obs_registry
     from racon_tpu.utils.jaxcache import cache_extras
     reg = obs_registry()
     for k, v in cache_extras(reg).items():
+        reg.set(k, v)
+    for k, v in pipeline_extras(reg).items():
         reg.set(k, v)
     tracer.finish(metrics=reg.snapshot())
     return 0

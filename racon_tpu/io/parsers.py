@@ -53,7 +53,17 @@ def _first_token(line: bytes) -> bytes:
 
 
 class ParseError(RuntimeError):
-    pass
+    """Parser failure. ``offset``, when known, is the byte offset into
+    the (decompressed) stream where the offending record begins — with
+    chunked ``parse(max_bytes)`` streaming, "line number" is meaningless
+    to a caller that resumed mid-file, but a byte offset can be handed
+    straight to ``dd``/``tail -c`` for inspection."""
+
+    def __init__(self, message: str, offset: Optional[int] = None):
+        if offset is not None:
+            message = f"{message} (at byte offset {offset})"
+        super().__init__(message)
+        self.offset = offset
 
 
 class Parser:
@@ -79,6 +89,15 @@ class Parser:
         raise NotImplementedError
 
     def parse(self, max_bytes: int = -1) -> Tuple[List[object], bool]:
+        """One chunk of records, plus whether more remain.
+
+        Repeated calls are safe to interleave with downstream
+        consumption of earlier chunks: every returned record owns fresh
+        immutable ``bytes`` (sliced out of the read blocks, never views
+        into a shared mutable buffer), so the streaming pipeline's build
+        stage can keep parsing while other threads still hold records
+        from previous chunks.
+        """
         if self._failed:
             raise ParseError(
                 f"[racon_tpu::io] error: parser for {self.path} previously "
@@ -111,22 +130,26 @@ class Parser:
         return recs
 
 
-def _block_lines(f, block: int = 1 << 22) -> Iterator[Tuple[bytes, int]]:
-    """Yield (line, nbytes) via block reads + split; line is newline/CR
-    stripped, nbytes is the exact on-stream length including the line
-    terminator (for byte-budgeted chunking).
+def _block_lines(f, block: int = 1 << 22
+                 ) -> Iterator[Tuple[bytes, int, int]]:
+    """Yield (line, nbytes, offset) via block reads + split; line is
+    newline/CR stripped, nbytes is the exact on-stream length including
+    the line terminator (for byte-budgeted chunking), offset the byte
+    position of the line's start in the decompressed stream (for
+    :class:`ParseError` diagnostics).
 
     Per-line ``readline`` on a gzip stream pays Python call overhead for
     every line — a genome-scale cost (tens of millions of lines at 30x
     human coverage); one 4 MB read + one split amortizes it away.
     """
     tail: List[bytes] = []          # blocks of the current partial line
+    pos = 0                         # stream offset of the current line
     while True:
         data = f.read(block)
         if not data:
             if tail:
                 last = b"".join(tail)
-                yield last.rstrip(b"\r"), len(last)
+                yield last.rstrip(b"\r"), len(last), pos
             return
         if b"\n" not in data:
             # No terminator in this block: defer the join, or a single
@@ -138,7 +161,9 @@ def _block_lines(f, block: int = 1 << 22) -> Iterator[Tuple[bytes, int]]:
         last = parts.pop()
         tail = [last] if last else []
         for ln in parts:
-            yield ln.rstrip(b"\r"), len(ln) + 1
+            nb = len(ln) + 1
+            yield ln.rstrip(b"\r"), nb, pos
+            pos += nb
 
 
 class FastaParser(Parser):
@@ -146,7 +171,7 @@ class FastaParser(Parser):
         name: Optional[bytes] = None
         chunks: List[bytes] = []
         with _open(self.path) as f:
-            for line, _ in _block_lines(f):
+            for line, _, off in _block_lines(f):
                 if line.startswith(b">"):
                     if name is not None:
                         data = b"".join(chunks)
@@ -156,8 +181,8 @@ class FastaParser(Parser):
                 elif line:
                     if name is None:
                         raise ParseError(
-                            f"[racon_tpu::io] error: malformed FASTA file {self.path}"
-                        )
+                            f"[racon_tpu::io] error: malformed FASTA file "
+                            f"{self.path}", offset=off)
                     chunks.append(line)
             if name is not None:
                 data = b"".join(chunks)
@@ -169,24 +194,27 @@ class FastqParser(Parser):
         with _open(self.path) as f:
             lines = _block_lines(f)
             while True:
-                header, _ = next(lines, (None, 0))
+                header, _, rec_off = next(lines, (None, 0, 0))
                 if header is None:
                     return
                 if not header:
                     continue
                 if not header.startswith(b"@"):
                     raise ParseError(
-                        f"[racon_tpu::io] error: malformed FASTQ file {self.path}"
-                    )
+                        f"[racon_tpu::io] error: malformed FASTQ file "
+                        f"{self.path}", offset=rec_off)
                 name = _first_token(header[1:])
                 # Sequence lines until '+' separator (tolerates multi-line).
                 data_chunks: List[bytes] = []
                 while True:
-                    line, _ = next(lines, (None, 0))
+                    line, _, _ = next(lines, (None, 0, 0))
                     if line is None:
+                        # EOF inside a record: report where the partial
+                        # record begins, not just which file broke.
                         raise ParseError(
-                            f"[racon_tpu::io] error: truncated FASTQ file {self.path}"
-                        )
+                            f"[racon_tpu::io] error: truncated FASTQ "
+                            f"file {self.path} — EOF inside the record "
+                            f"starting", offset=rec_off)
                     if line.startswith(b"+"):
                         break
                     data_chunks.append(line)
@@ -194,18 +222,19 @@ class FastqParser(Parser):
                 qual_chunks: List[bytes] = []
                 qlen = 0
                 while qlen < len(data):
-                    line, _ = next(lines, (None, 0))
+                    line, _, _ = next(lines, (None, 0, 0))
                     if line is None:
                         raise ParseError(
-                            f"[racon_tpu::io] error: truncated FASTQ file {self.path}"
-                        )
+                            f"[racon_tpu::io] error: truncated FASTQ "
+                            f"file {self.path} — EOF inside the record "
+                            f"starting", offset=rec_off)
                     qual_chunks.append(line)
                     qlen += len(line)
                 quality = b"".join(qual_chunks)
                 if len(quality) != len(data):
                     raise ParseError(
-                        f"[racon_tpu::io] error: quality length mismatch in {self.path}"
-                    )
+                        f"[racon_tpu::io] error: quality length mismatch "
+                        f"in {self.path}", offset=rec_off)
                 # Phred bytes below '!' (33) would decode to negative
                 # weights; reject here so every downstream consumer (host
                 # and device consensus paths) can assume weights >= 0 by
@@ -214,8 +243,7 @@ class FastqParser(Parser):
                         np.frombuffer(quality, np.uint8).min()) < 33:
                     raise ParseError(
                         f"[racon_tpu::io] error: malformed quality string "
-                        f"(byte below '!') in {self.path}"
-                    )
+                        f"(byte below '!') in {self.path}", offset=rec_off)
                 yield Sequence(name.decode(), data, quality), len(name) + 2 * len(data)
 
 
@@ -226,14 +254,14 @@ class MhapParser(Parser):
 
     def _records(self) -> Iterator[Tuple[Overlap, int]]:
         with _open(self.path) as f:
-            for line, nb in _block_lines(f):
+            for line, nb, off in _block_lines(f):
                 if not line:
                     continue
                 t = line.split()
                 if len(t) < 12:
                     raise ParseError(
-                        f"[racon_tpu::io] error: malformed MHAP file {self.path}"
-                    )
+                        f"[racon_tpu::io] error: malformed MHAP file "
+                        f"{self.path}", offset=off)
                 yield Overlap.from_mhap(
                     int(t[0]), int(t[1]), float(t[2]), int(t[3]),
                     int(t[4]), int(t[5]), int(t[6]), int(t[7]),
@@ -248,14 +276,14 @@ class PafParser(Parser):
 
     def _records(self) -> Iterator[Tuple[Overlap, int]]:
         with _open(self.path) as f:
-            for line, nb in _block_lines(f):
+            for line, nb, off in _block_lines(f):
                 if not line:
                     continue
                 t = line.split(b"\t")
                 if len(t) < 12:
                     raise ParseError(
-                        f"[racon_tpu::io] error: malformed PAF file {self.path}"
-                    )
+                        f"[racon_tpu::io] error: malformed PAF file "
+                        f"{self.path}", offset=off)
                 yield Overlap.from_paf(
                     t[0].decode(), int(t[1]), int(t[2]), int(t[3]),
                     t[4].decode(), t[5].decode(), int(t[6]), int(t[7]),
@@ -269,7 +297,7 @@ class SamParser(Parser):
 
     def _records(self) -> Iterator[Tuple[Overlap, int]]:
         with _open(self.path) as f:
-            for line, nb in _block_lines(f):
+            for line, nb, off in _block_lines(f):
                 if line.startswith(b"@"):
                     continue
                 if not line:
@@ -277,8 +305,8 @@ class SamParser(Parser):
                 t = line.split(b"\t")
                 if len(t) < 11:
                     raise ParseError(
-                        f"[racon_tpu::io] error: malformed SAM file {self.path}"
-                    )
+                        f"[racon_tpu::io] error: malformed SAM file "
+                        f"{self.path}", offset=off)
                 yield Overlap.from_sam(
                     t[0].decode(), int(t[1]), t[2].decode(), int(t[3]),
                     t[5].decode(),

@@ -331,7 +331,16 @@ class Polisher:
     def polish(self, drop_unpolished_sequences: bool = True
                ) -> List[PolishedSequence]:
         """Batch windows through the engine, stitch contigs in order, tag
-        and emit (src/polisher.cpp:451-513)."""
+        and emit (src/polisher.cpp:451-513).
+
+        With the streaming pipeline enabled (RACON_TPU_PIPELINE /
+        --pipeline-depth; racon_tpu/pipeline/) this delegates to
+        :meth:`polish_stream` — same records, bit-identical, just
+        produced through the overlapped executor.
+        """
+        from racon_tpu.pipeline import pipeline_enabled
+        if pipeline_enabled():
+            return list(self.polish_stream(drop_unpolished_sequences))
         log = self.logger
         log.begin()
 
@@ -339,6 +348,52 @@ class Polisher:
         for s in range(0, n_windows, self.window_chunk):
             self.engine.consensus_windows(self.windows[s:s + self.window_chunk])
             log.tick("[racon_tpu::Polisher::polish] generating consensus")
+        self._log_sched_summary()
+
+        asm = _ContigAssembler(self, drop_unpolished_sequences)
+        dst: List[PolishedSequence] = []
+        for i, w in enumerate(self.windows):
+            rec = asm.feed(i, w)
+            if rec is not None:
+                dst.append(rec)
+
+        log.phase("[racon_tpu::Polisher::polish] generated consensus")
+        self.windows = []
+        return dst
+
+    def polish_stream(self, drop_unpolished_sequences: bool = True):
+        """Streaming polish: yield each PolishedSequence as soon as all
+        of its windows finalize, while later windows are still being
+        packed/computed (racon_tpu/pipeline/streaming.py).
+
+        The pipeline retires window slices out of order (host-path items
+        overtake device chunks), but stream_consensus releases ranges in
+        input order, so records come out exactly as polish() would list
+        them — the two are differentially tested bit-identical.
+        """
+        log = self.logger
+        log.begin()
+        from racon_tpu.pipeline import pipeline_depth
+        from racon_tpu.pipeline.streaming import stream_consensus
+
+        asm = _ContigAssembler(self, drop_unpolished_sequences)
+
+        def _tick():
+            log.tick("[racon_tpu::Polisher::polish] generating consensus")
+
+        for s, e in stream_consensus(self.engine, self.windows,
+                                     chunk=self.window_chunk,
+                                     depth=pipeline_depth(), tick=_tick):
+            for i in range(s, e):
+                rec = asm.feed(i, self.windows[i])
+                if rec is not None:
+                    yield rec
+
+        self._log_sched_summary()
+        log.phase("[racon_tpu::Polisher::polish] generated consensus")
+        self.windows = []
+
+    def _log_sched_summary(self) -> None:
         telem = getattr(self.engine, "sched_telemetry", None)
         if telem is not None and telem.windows:
             # One source of truth: the counters go into the process
@@ -347,32 +402,46 @@ class Polisher:
             from racon_tpu.obs.metrics import (publish_sched, registry,
                                                sched_summary_line)
             publish_sched(telem, registry())
-            log.line("[racon_tpu::Polisher::polish] scheduler " +
-                     sched_summary_line(registry()))
+            self.logger.line("[racon_tpu::Polisher::polish] scheduler " +
+                             sched_summary_line(registry()))
 
-        dst: List[PolishedSequence] = []
-        polished_data: List[bytes] = []
-        num_polished = 0
-        for i, w in enumerate(self.windows):
-            num_polished += 1 if w.polished else 0
-            polished_data.append(w.consensus or b"")
-            last = (i == n_windows - 1) or (self.windows[i + 1].rank == 0)
-            if last:
-                ratio = num_polished / (w.rank + 1)
-                if not drop_unpolished_sequences or ratio > 0:
-                    data = b"".join(polished_data)
-                    tags = "r" if self.type == PolisherType.kF else ""
-                    tags += f" LN:i:{len(data)}"
-                    tags += f" RC:i:{self.targets_coverages[w.id]}"
-                    tags += f" XC:f:{ratio:.6f}"
-                    dst.append(PolishedSequence(
-                        self.sequences[w.id].name + tags, data))
-                num_polished = 0
-                polished_data.clear()
 
-        log.phase("[racon_tpu::Polisher::polish] generated consensus")
-        self.windows = []
-        return dst
+class _ContigAssembler:
+    """Incremental contig stitching: feed finalized windows in input
+    order; the last window of each target returns the stitched, tagged
+    PolishedSequence (or None when dropped as unpolished). One
+    implementation serves polish() and polish_stream() so the record
+    format cannot drift between the serial and streaming paths
+    (src/polisher.cpp:478-508)."""
+
+    __slots__ = ("p", "drop", "n_windows", "_data", "_num_polished")
+
+    def __init__(self, polisher: Polisher, drop_unpolished: bool):
+        self.p = polisher
+        self.drop = drop_unpolished
+        self.n_windows = len(polisher.windows)
+        self._data: List[bytes] = []
+        self._num_polished = 0
+
+    def feed(self, i: int, w: Window) -> Optional[PolishedSequence]:
+        p = self.p
+        self._num_polished += 1 if w.polished else 0
+        self._data.append(w.consensus or b"")
+        last = (i == self.n_windows - 1) or (p.windows[i + 1].rank == 0)
+        if not last:
+            return None
+        ratio = self._num_polished / (w.rank + 1)
+        rec: Optional[PolishedSequence] = None
+        if not self.drop or ratio > 0:
+            data = b"".join(self._data)
+            tags = "r" if p.type == PolisherType.kF else ""
+            tags += f" LN:i:{len(data)}"
+            tags += f" RC:i:{p.targets_coverages[w.id]}"
+            tags += f" XC:f:{ratio:.6f}"
+            rec = PolishedSequence(p.sequences[w.id].name + tags, data)
+        self._num_polished = 0
+        self._data = []
+        return rec
 
 
 def _filter_overlap_group(group: List[Overlap], error_threshold: float,

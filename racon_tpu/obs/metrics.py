@@ -62,6 +62,13 @@ class MetricsRegistry:
         with self._lock:
             self._v[key] = value
 
+    def max(self, key: str, value: float) -> None:
+        """Keep the running maximum (gauge peaks, e.g. queue depth)."""
+        with self._lock:
+            cur = self._v.get(key)
+            if cur is None or value > cur:
+                self._v[key] = value
+
     def get(self, key: str, default: object = 0) -> object:
         with self._lock:
             return self._v.get(key, default)
@@ -148,6 +155,64 @@ def transfer_extras(reg: Optional[MetricsRegistry] = None
     n = int(reg.get("device_dispatches", 0))
     if n:
         out["device_dispatches"] = n
+    return out
+
+
+# ------------------------------------------------------ pipeline gauges
+
+def record_stage(name: str, busy_s: float, stall_in_s: float,
+                 stall_out_s: float, items: int,
+                 reg: Optional[MetricsRegistry] = None) -> None:
+    """Account one pipeline stage's lifetime totals (called when the
+    stage thread exits; racon_tpu/pipeline/stages.py). ``busy`` is time
+    in the stage's work function, ``stall`` time blocked on its input
+    (starved) or output (choked) queue — together they say which stage
+    bounds the pipeline. ``pipe_stage_compute_busy_s`` doubles as the
+    device-busy term of the overlap-efficiency ratio."""
+    reg = reg if reg is not None else _REGISTRY
+    reg.inc(f"pipe_stage_{name}_busy_s", float(busy_s))
+    reg.inc(f"pipe_stage_{name}_stall_in_s", float(stall_in_s))
+    reg.inc(f"pipe_stage_{name}_stall_out_s", float(stall_out_s))
+    reg.inc(f"pipe_stage_{name}_items", int(items))
+
+
+def record_queue(name: str, peak: int, put_wait_s: float,
+                 get_wait_s: float,
+                 reg: Optional[MetricsRegistry] = None) -> None:
+    """Account one bounded queue's gauges (peak depth is a max across
+    pipeline runs, blocked times accumulate)."""
+    reg = reg if reg is not None else _REGISTRY
+    reg.max(f"pipe_queue_{name}_peak", int(peak))
+    reg.inc(f"pipe_queue_{name}_put_wait_s", float(put_wait_s))
+    reg.inc(f"pipe_queue_{name}_get_wait_s", float(get_wait_s))
+
+
+def record_pipeline_wall(seconds: float,
+                         reg: Optional[MetricsRegistry] = None) -> None:
+    """Account one stream_consensus invocation's wall time — the
+    denominator of overlap efficiency."""
+    reg = reg if reg is not None else _REGISTRY
+    reg.inc("pipe_runs")
+    reg.inc("pipe_wall_s", float(seconds))
+
+
+def pipeline_extras(reg: Optional[MetricsRegistry] = None
+                    ) -> Dict[str, object]:
+    """The registry's pipe_* keys as a JSON-ready dict (bench extras /
+    obs_report "Pipeline" section), plus the derived overlap efficiency
+    = device-busy (compute stage) / pipeline wall. Empty when no
+    pipeline ran."""
+    reg = reg if reg is not None else _REGISTRY
+    if not int(reg.get("pipe_runs", 0)):
+        return {}
+    out: Dict[str, object] = {}
+    for k, v in sorted(reg.snapshot().items()):
+        if k.startswith("pipe_"):
+            out[k] = round(v, 4) if isinstance(v, float) else v
+    wall = float(reg.get("pipe_wall_s", 0.0))
+    busy = float(reg.get("pipe_stage_compute_busy_s", 0.0))
+    if wall > 0:
+        out["pipe_overlap_efficiency"] = round(busy / wall, 4)
     return out
 
 

@@ -676,9 +676,39 @@ def _pack_body(codes, cov, alen, ovf):
 _pack_out = functools.partial(__import__("jax").jit)(_pack_body)
 
 
+def put_chunk_bufs(plan: ChunkPlan, mesh=None) -> Tuple[object, object]:
+    """Start the (async) h2d of a chunk's two packed byte buffers.
+
+    ``jax.device_put`` returns immediately, so calling this for chunk
+    i+1 before chunk i's results sync overlaps the transfer with
+    compute — the primitive behind both the scheduler's prefetch
+    (sched/scheduler.py::put_chunk) and the streaming pipeline's h2d
+    stage (racon_tpu/pipeline/streaming.py). The recorded seconds cover
+    only the synchronous serialization/enqueue portion.
+    """
+    import time
+    import jax
+    from racon_tpu.obs.metrics import record_h2d
+
+    job_h, win_h = plan.packed_bufs()
+    t0 = time.perf_counter()
+    if mesh is None:
+        job_buf, win_buf = jax.device_put((job_h, win_h))
+    else:
+        from jax.sharding import NamedSharding, PartitionSpec
+        job_buf = jax.device_put(
+            job_h, NamedSharding(mesh, PartitionSpec("dp")))
+        win_buf = jax.device_put(
+            win_h, NamedSharding(mesh, PartitionSpec()))
+    record_h2d(job_h.nbytes + win_h.nbytes, time.perf_counter() - t0,
+               name="h2d/chunk")
+    return job_buf, win_buf
+
+
 def dispatch_chunk(plan: ChunkPlan, *, match: int, mismatch: int,
                    gap: int, ins_scale: float, rounds: int,
-                   stats: Optional[dict] = None, mesh=None):
+                   stats: Optional[dict] = None, mesh=None,
+                   bufs: Optional[Tuple[object, object]] = None):
     """Ship a chunk to the device and chain all refinement rounds —
     returns the (still in-flight) packed output array. No host sync:
     the caller may dispatch further chunks before collecting, so h2d of
@@ -690,6 +720,11 @@ def dispatch_chunk(plan: ChunkPlan, *, match: int, mismatch: int,
     collecting stats serializes the pipeline and adds two tunnel
     round-trips per chunk; production runs pass None and pay nothing.
     RACON_TPU_TIMING=1 additionally prints each round's time to stderr.
+
+    ``bufs`` takes a pre-transferred :func:`put_chunk_bufs` result so a
+    caller can overlap the h2d with earlier compute; None ships the
+    buffers here. Honored on the production path only (the verbose
+    per-round path ships separate arrays).
     """
     import os
     import sys
@@ -722,18 +757,9 @@ def dispatch_chunk(plan: ChunkPlan, *, match: int, mismatch: int,
         # (all rounds + output packing) as ONE dispatch — per-transfer
         # and per-dispatch tunnel latency otherwise dominate. Stats
         # collection syncs once on each phase edge.
-        job_h, win_h = plan.packed_bufs()
-        t_put = time.perf_counter()
-        if mesh is None:
-            job_buf, win_buf = jax.device_put((job_h, win_h))
-        else:
-            from jax.sharding import NamedSharding, PartitionSpec
-            job_buf = jax.device_put(
-                job_h, NamedSharding(mesh, PartitionSpec("dp")))
-            win_buf = jax.device_put(
-                win_h, NamedSharding(mesh, PartitionSpec()))
-        record_h2d(job_h.nbytes + win_h.nbytes,
-                   time.perf_counter() - t_put, name="h2d/chunk")
+        if bufs is None:
+            bufs = put_chunk_bufs(plan, mesh=mesh)
+        job_buf, win_buf = bufs
         if collect:
             # Sync on BOTH buffers: device_put is async, and an
             # in-flight job_buf would otherwise bleed into "compute".

@@ -81,6 +81,27 @@ def _round_up(n: int, mult: int = 128) -> int:
     return ((max(n, 1) + mult - 1) // mult) * mult
 
 
+class _DeviceSlicePlan:
+    """One consensus slice's device decomposition: balanced chunk groups
+    sharing run-level caps, plus the windows that must take the host
+    path (jumbo/wide geometry, or everything when ``overflow_msg`` is
+    set). Produced by PoaEngine._plan_device_slice and consumed both by
+    the serial path and the streaming pipeline, so the two can never
+    disagree on chunk composition."""
+    __slots__ = ("groups", "host", "lq_cap", "la_cap", "band_cap",
+                 "n_shards", "overflow_msg")
+
+    def __init__(self, lq_cap: int, la_cap: int, band_cap: Optional[int],
+                 n_shards: int):
+        self.groups: List[List[Window]] = []
+        self.host: List[Window] = []
+        self.lq_cap = lq_cap
+        self.la_cap = la_cap
+        self.band_cap = band_cap
+        self.n_shards = n_shards
+        self.overflow_msg: Optional[str] = None
+
+
 class PoaEngine:
     """Batched consensus over windows.
 
@@ -211,13 +232,16 @@ class PoaEngine:
                 la_max = max(la_max, int(la))
         return dev, host, lq_max, la_max
 
-    def _consensus_device(self, active: List[Window], lq_max: int,
-                          la_max: int) -> int:
-        """Device-resident path: all refinement rounds on chip, one h2d /
-        one d2h per chunk (racon_tpu/ops/device_poa.py)."""
-        from racon_tpu.ops.device_poa import (ChunkPlan, dispatch_chunk,
-                                              collect_chunk, run_caps,
-                                              _bucket_b, MAX_DIR_ELEMS)
+    def _plan_device_slice(self, active: List[Window], lq_max: int,
+                           la_max: int) -> "_DeviceSlicePlan":
+        """Decompose one slice of device windows into balanced chunk
+        groups plus a host-fallback set — THE single decomposition both
+        the serial path below and the streaming pipeline
+        (racon_tpu/pipeline/streaming.py) run, so the two produce
+        identical chunks (and therefore identical output) by
+        construction."""
+        from racon_tpu.ops.device_poa import (run_caps, _bucket_b,
+                                              MAX_DIR_ELEMS)
         # One (Lq, LA) cap pair for the whole run (cap-history reuse):
         # every chunk shares a single compiled device_round executable
         # instead of paying a multi-second XLA compile per shape.
@@ -235,29 +259,30 @@ class PoaEngine:
         while jobs_cap > 128 and \
                 _bucket_b(jobs_cap) * lq_cap * dirs_cols > MAX_DIR_ELEMS:
             jobs_cap //= 2
+        n_shards = self.mesh.shape["dp"] if self.mesh is not None else 1
+        sp = _DeviceSlicePlan(lq_cap, la_cap, w_run or None, n_shards)
         if _bucket_b(jobs_cap) * lq_cap * dirs_cols > MAX_DIR_ELEMS:
             # Even a minimum-bucket chunk overflows the int32 flat-index
             # range at these caps (pathological mixed geometry): host path.
-            print(f"[racon_tpu::PoaEngine] run geometry (Lq={lq_cap}, "
-                  f"LA={la_cap}) overflows the device index budget even "
-                  f"at the minimum chunk size; polishing {len(active)} "
-                  "window(s) on the host path", file=self.log)
-            return self._consensus_host(active, force_native=True)
+            sp.host = list(active)
+            sp.overflow_msg = (
+                f"[racon_tpu::PoaEngine] run geometry (Lq={lq_cap}, "
+                f"LA={la_cap}) overflows the device index budget even "
+                f"at the minimum chunk size; polishing {len(active)} "
+                "window(s) on the host path")
+            return sp
         # Windows too wide for any chunk at these caps take the host path
         # ("not ws" below would otherwise admit them into an over-cap
         # bucket, wrapping the traceback's int32 flat index).
-        wide = [w for w in active if w.n_layers > jobs_cap]
-        n_wide = 0
-        if wide:
+        sp.host = [w for w in active if w.n_layers > jobs_cap]
+        if sp.host:
             active = [w for w in active if w.n_layers <= jobs_cap]
-            n_wide = self._consensus_host(wide, force_native=True)
         # Balance jobs across the minimum number of chunks: equal-size
         # chunks land in one B bucket (one compiled executable) where a
         # greedy full-then-remainder split would produce two.
         total_jobs = sum(w.n_layers for w in active)
         n_chunks = max(1, -(-total_jobs // jobs_cap))
         target = -(-total_jobs // n_chunks)
-        groups: List[List[Window]] = []
         i = 0
         while i < len(active):
             ws: List[Window] = []
@@ -267,29 +292,74 @@ class PoaEngine:
                 ws.append(active[i])
                 jobs += active[i].n_layers
                 i += 1
-            groups.append(ws)
-        n_shards = self.mesh.shape["dp"] if self.mesh is not None else 1
+            sp.groups.append(ws)
+        return sp
+
+    def _make_chunk_plan(self, sp: "_DeviceSlicePlan", ws: List[Window]):
+        from racon_tpu.ops.device_poa import ChunkPlan
+        return ChunkPlan(ws, lq_cap=sp.lq_cap, la_cap=sp.la_cap,
+                         n_shards=sp.n_shards, band_cap=sp.band_cap)
+
+    def _apply_group(self, ws: List[Window], codes, covs,
+                     trunc: List[Window]) -> None:
+        """Apply one collected chunk's consensus; windows whose result
+        overflowed the padded anchor width collect into ``trunc``."""
+        for w, c, cv in zip(ws, codes, covs):
+            if c is None:
+                # Consensus outgrew the chunk's padded anchor width
+                # (sticky device ovf flag): the device result is
+                # truncated; the host path is unbounded.
+                trunc.append(w)
+                continue
+            w.apply_consensus(
+                decode_bases(np.frombuffer(c, dtype=np.uint8)), cv,
+                log=self.log)
+
+    def _redo_trunc(self, trunc: List[Window]) -> None:
+        if trunc:
+            print(f"[racon_tpu::PoaEngine] {len(trunc)} window(s) "
+                  "outgrew the device anchor budget; re-polishing on "
+                  "the host path", file=self.log)
+            self._consensus_host(trunc, force_native=True)
+
+    def _make_scheduler(self):
+        """ConvergenceScheduler wired to this engine's (shared, run-
+        accumulating) telemetry — one construction for the serial sched
+        path and the streaming pipeline's compute stage."""
+        from racon_tpu.sched import ConvergenceScheduler, SchedTelemetry
+        rounds = self.refine_rounds + 1
+        if self.sched_telemetry is None or \
+                self.sched_telemetry.rounds != rounds:
+            self.sched_telemetry = SchedTelemetry(rounds)
+        return ConvergenceScheduler(
+            match=self.match, mismatch=self.mismatch, gap=self.gap,
+            scales=self._round_scales(rounds), mesh=self.mesh,
+            telemetry=self.sched_telemetry)
+
+    def _consensus_device(self, active: List[Window], lq_max: int,
+                          la_max: int) -> int:
+        """Device-resident path: all refinement rounds on chip, one h2d /
+        one d2h per chunk (racon_tpu/ops/device_poa.py)."""
+        from racon_tpu.ops.device_poa import dispatch_chunk, collect_chunk
+        sp = self._plan_device_slice(active, lq_max, la_max)
+        if sp.overflow_msg:
+            print(sp.overflow_msg, file=self.log)
+            return self._consensus_host(sp.host, force_native=True)
+        n_wide = 0
+        if sp.host:
+            n_wide = self._consensus_host(sp.host, force_native=True)
+        groups = sp.groups
+        active = [w for g in groups for w in g]
         trunc: List[Window] = []
 
-        def make_plan(ws: List[Window]) -> ChunkPlan:
-            return ChunkPlan(ws, lq_cap=lq_cap, la_cap=la_cap,
-                             n_shards=n_shards, band_cap=w_run or None)
+        def make_plan(ws: List[Window]):
+            return self._make_chunk_plan(sp, ws)
 
         def apply(ws, codes, covs) -> None:
-            for w, c, cv in zip(ws, codes, covs):
-                if c is None:
-                    # Consensus outgrew the chunk's padded anchor width
-                    # (sticky device ovf flag): the device result is
-                    # truncated; the host path is unbounded.
-                    trunc.append(w)
-                    continue
-                w.apply_consensus(
-                    decode_bases(np.frombuffer(c, dtype=np.uint8)), cv,
-                    log=self.log)
+            self._apply_group(ws, codes, covs, trunc)
 
         from racon_tpu.obs.trace import get_tracer
-        from racon_tpu.sched import (ConvergenceScheduler, SchedTelemetry,
-                                     sched_enabled)
+        from racon_tpu.sched import sched_enabled
         tracer = get_tracer()
         if sched_enabled():
             # Convergence-aware path (racon_tpu/sched/): per-window
@@ -297,14 +367,7 @@ class PoaEngine:
             # syncs preclude the fixed path's depth-2 dispatch pipeline,
             # so overlap comes from prefetching the NEXT chunk's h2d
             # (async device_put) before running the current rounds.
-            rounds = self.refine_rounds + 1
-            if self.sched_telemetry is None or \
-                    self.sched_telemetry.rounds != rounds:
-                self.sched_telemetry = SchedTelemetry(rounds)
-            sched = ConvergenceScheduler(
-                match=self.match, mismatch=self.mismatch, gap=self.gap,
-                scales=self._round_scales(rounds), mesh=self.mesh,
-                telemetry=self.sched_telemetry)
+            sched = self._make_scheduler()
             plan = make_plan(groups[0]) if groups else None
             bufs = sched.put_chunk(plan) if plan is not None else None
             for k, ws in enumerate(groups):
@@ -354,11 +417,7 @@ class PoaEngine:
                     finish(pending.pop(0))
             for entry in pending:
                 finish(entry)
-        if trunc:
-            print(f"[racon_tpu::PoaEngine] {len(trunc)} window(s) "
-                  "outgrew the device anchor budget; re-polishing on "
-                  "the host path", file=self.log)
-            self._consensus_host(trunc, force_native=True)
+        self._redo_trunc(trunc)
         return len(active) + n_wide
 
     @staticmethod

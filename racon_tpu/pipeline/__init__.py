@@ -1,0 +1,97 @@
+"""Streaming execution pipeline: overlapped parse/pack -> device compute
+-> decode/write with bounded queues and double buffering.
+
+The reference polisher is a strictly serial phase machine (parse
+everything, align everything, emit everything — src/polisher.cpp
+``initialize()``/``polish()``), and BENCH_r05 shows what that costs on a
+device backend: 321.5 compute-only windows/s/chip but only 184.6 end to
+end — the TPU idles ~43% of wall time while the host encodes, packs,
+and writes. This package is the classic input-pipeline answer from
+training/inference stacks, applied to polishing:
+
+- :mod:`racon_tpu.pipeline.queues` — bounded MPMC queues with
+  backpressure, depth gauges, and blocked-time accounting;
+- :mod:`racon_tpu.pipeline.stages` — single-thread stages wired by
+  queues, with clean shutdown and exception propagation (a stage
+  failure aborts every queue and re-raises at the consumer);
+- :mod:`racon_tpu.pipeline.streaming` — the polish-specific executor:
+  window chunks flow through pack (host encode) -> h2d (async
+  device_put, double-buffered) -> compute (device rounds + d2h decode),
+  while ordered retirement releases contiguous window ranges for
+  streaming FASTA emission even when chunks retire out of order.
+
+Gating: the pipeline is OFF by default. ``RACON_TPU_PIPELINE=1`` (or
+the CLI's ``--pipeline-depth N`` with N > 0) turns it on;
+``RACON_TPU_PIPELINE=0`` forces the serial path regardless of the CLI
+knob, and the two paths are bit-identical on the polished FASTA
+(differential tests in tests/test_pipeline.py; docs/PIPELINE.md has the
+stage diagram and failure semantics).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+ENV_PIPELINE = "RACON_TPU_PIPELINE"
+ENV_DEPTH = "RACON_TPU_PIPELINE_DEPTH"
+
+#: Default bound on in-flight chunks per queue: depth 2 = classic double
+#: buffering (chunk N computes while chunk N+1's buffers sit in HBM).
+DEFAULT_DEPTH = 2
+
+# CLI override (configure()); None = environment decides.
+_cli_depth: Optional[int] = None
+
+
+def configure(depth: Optional[int]) -> None:
+    """Install the CLI's --pipeline-depth knob for this process.
+
+    ``depth > 0`` enables the pipeline with that bound; ``depth == 0``
+    disables it; ``None`` leaves the decision to the environment.
+    ``RACON_TPU_PIPELINE=0`` always wins (the serial-path escape hatch
+    must not be maskable from the command line).
+    """
+    global _cli_depth
+    if depth is not None and depth < 0:
+        raise ValueError(
+            f"[racon_tpu::pipeline] invalid pipeline depth {depth}")
+    _cli_depth = depth
+
+
+def pipeline_enabled() -> bool:
+    """Streaming pipeline gate (module docstring has the truth table)."""
+    env = os.environ.get(ENV_PIPELINE, "")
+    if env in ("0", "false"):
+        return False
+    if _cli_depth is not None:
+        return _cli_depth > 0
+    return env not in ("",)
+
+
+def pipeline_depth() -> int:
+    """Bounded-queue capacity (in-flight chunks per stage edge)."""
+    if _cli_depth is not None and _cli_depth > 0:
+        return _cli_depth
+    env = os.environ.get(ENV_DEPTH, "")
+    if env:
+        try:
+            d = int(env)
+        except ValueError as exc:
+            raise ValueError(
+                f"[racon_tpu::pipeline] invalid {ENV_DEPTH}={env!r}"
+            ) from exc
+        if d > 0:
+            return d
+    return DEFAULT_DEPTH
+
+
+from racon_tpu.pipeline.queues import (BoundedQueue, PipelineAborted,  # noqa: E402
+                                       QueueClosed)
+from racon_tpu.pipeline.stages import Pipeline, StageError  # noqa: E402
+
+__all__ = [
+    "BoundedQueue", "DEFAULT_DEPTH", "ENV_DEPTH", "ENV_PIPELINE",
+    "Pipeline", "PipelineAborted", "QueueClosed", "StageError",
+    "configure", "pipeline_depth", "pipeline_enabled",
+]

@@ -1,0 +1,226 @@
+"""Thread stages and the pipeline driver.
+
+A :class:`Pipeline` is a linear chain of single-thread stages connected
+by :class:`~racon_tpu.pipeline.queues.BoundedQueue` edges. One thread
+per stage keeps per-stage work strictly ordered (the streaming polish
+path needs deterministic chunk planning and a single JAX dispatch
+stream); overlap comes from *different* stages running concurrently,
+bounded by the queue capacities.
+
+Failure semantics — the part serial code gets for free and threaded
+code must earn:
+
+- A stage that raises reports the exception to the driver, which aborts
+  every queue; all other stages unblock, observe the abort, and exit.
+- The consumer's :meth:`Pipeline.drain` re-raises the first failure as
+  :class:`StageError` with the original exception chained (``raise ...
+  from exc``), so tracebacks survive the thread hop.
+- ``with pipeline:`` guarantees every stage thread is joined on exit —
+  including when the consumer abandons the drain loop early (generator
+  close), in which case the driver aborts the queues first so no
+  producer can hang on a full edge.
+
+Accounting: every stage records busy seconds (time in its work
+function), stall seconds (blocked on its input or output queue), and an
+item count into the obs metrics registry (``pipe_stage_*`` keys), and
+emits a ``stage`` span when it exits; every queue records peak depth
+and blocked time (``pipe_queue_*`` keys, ``queue`` spans). Overlap
+efficiency — device-busy over wall — falls out of these numbers
+(obs/metrics.py::pipeline_extras).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Iterable, List, Optional, Tuple
+
+from racon_tpu.pipeline.queues import (BoundedQueue, PipelineAborted,
+                                       QueueClosed)
+
+
+class StageError(RuntimeError):
+    """A pipeline stage failed; ``__cause__`` is the original exception."""
+
+    def __init__(self, stage: str, exc: BaseException):
+        super().__init__(
+            f"[racon_tpu::pipeline] stage {stage!r} failed: {exc!r}")
+        self.stage = stage
+
+
+class _Stage(threading.Thread):
+    """One worker thread: pull from ``inq`` (or iterate ``source``),
+    apply ``fn``, push to ``outq``; close ``outq`` on clean exit."""
+
+    def __init__(self, pipe: "Pipeline", name: str,
+                 fn: Optional[Callable] = None,
+                 source: Optional[Callable[[], Iterable]] = None,
+                 inq: Optional[BoundedQueue] = None,
+                 outq: Optional[BoundedQueue] = None):
+        super().__init__(name=f"racon-pipe-{name}", daemon=True)
+        self.pipe = pipe
+        self.stage_name = name
+        self.fn = fn
+        self.source = source
+        self.inq = inq
+        self.outq = outq
+        self.busy_s = 0.0
+        self.stall_in_s = 0.0
+        self.stall_out_s = 0.0
+        self.items = 0
+
+    def run(self) -> None:
+        t_start = time.perf_counter()
+        failed = False
+        try:
+            if self.source is not None:
+                self._run_source()
+            else:
+                self._run_worker()
+        except (QueueClosed, PipelineAborted):
+            pass  # a peer ended the stream or tore the pipeline down
+        except BaseException as exc:  # noqa: BLE001 — must cross threads
+            failed = True
+            self.pipe._fail(self.stage_name, exc)
+        finally:
+            if self.outq is not None and not failed:
+                self.outq.close()
+            self._publish(t_start)
+
+    def _run_source(self) -> None:
+        it = iter(self.source())
+        while True:
+            t0 = time.perf_counter()
+            try:
+                item = next(it)
+            except StopIteration:
+                self.busy_s += time.perf_counter() - t0
+                return
+            self.busy_s += time.perf_counter() - t0
+            t1 = time.perf_counter()
+            self.outq.put(item)
+            self.stall_out_s += time.perf_counter() - t1
+            self.items += 1
+
+    def _run_worker(self) -> None:
+        while True:
+            t0 = time.perf_counter()
+            item = self.inq.get()            # QueueClosed ends the loop
+            self.stall_in_s += time.perf_counter() - t0
+            t1 = time.perf_counter()
+            out = self.fn(item)
+            self.busy_s += time.perf_counter() - t1
+            if self.outq is not None and out is not None:
+                t2 = time.perf_counter()
+                self.outq.put(out)
+                self.stall_out_s += time.perf_counter() - t2
+            self.items += 1
+
+    def _publish(self, t_start: float) -> None:
+        from racon_tpu.obs.metrics import record_stage
+        from racon_tpu.obs.trace import get_tracer
+        record_stage(self.stage_name, self.busy_s, self.stall_in_s,
+                     self.stall_out_s, self.items)
+        get_tracer().emit(
+            "stage", self.stage_name, t_start,
+            time.perf_counter() - t_start, items=self.items,
+            busy_s=round(self.busy_s, 6),
+            stall_s=round(self.stall_in_s + self.stall_out_s, 6))
+
+
+class Pipeline:
+    """Linear stage chain; see the module docstring for semantics."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._queues: List[BoundedQueue] = []
+        self._stages: List[_Stage] = []
+        self._error: Optional[Tuple[str, BaseException]] = None
+        self._error_lock = threading.Lock()
+        self._started = False
+
+    # ----------------------------------------------------------- assembly
+
+    def queue(self, name: str, capacity: int) -> BoundedQueue:
+        q = BoundedQueue(name, capacity)
+        self._queues.append(q)
+        return q
+
+    def source(self, name: str, gen_fn: Callable[[], Iterable],
+               outq: BoundedQueue) -> None:
+        """First stage: iterate ``gen_fn()`` into ``outq``."""
+        self._stages.append(_Stage(self, name, source=gen_fn, outq=outq))
+
+    def stage(self, name: str, fn: Callable, inq: BoundedQueue,
+              outq: Optional[BoundedQueue] = None) -> None:
+        """Worker stage: ``outq.put(fn(item))`` per ``inq`` item. A fn
+        returning None consumes the item (nothing is forwarded — e.g.
+        after routing it to a side queue itself)."""
+        self._stages.append(_Stage(self, name, fn=fn, inq=inq, outq=outq))
+
+    # ---------------------------------------------------------- execution
+
+    def _fail(self, stage: str, exc: BaseException) -> None:
+        with self._error_lock:
+            if self._error is None:
+                self._error = (stage, exc)
+        for q in self._queues:
+            q.abort()
+
+    def raise_if_failed(self) -> None:
+        with self._error_lock:
+            err = self._error
+        if err is not None:
+            stage, exc = err
+            raise StageError(stage, exc) from exc
+
+    def start(self) -> "Pipeline":
+        if self._started:
+            raise RuntimeError(
+                f"[racon_tpu::pipeline] pipeline {self.name!r} already "
+                "started")
+        self._started = True
+        for s in self._stages:
+            s.start()
+        return self
+
+    def drain(self, q: BoundedQueue):
+        """Yield items from the terminal queue until the stream ends;
+        re-raise the first stage failure (if any) when it does."""
+        while True:
+            try:
+                item = q.get()
+            except (QueueClosed, PipelineAborted):
+                break
+            yield item
+        self.raise_if_failed()
+
+    def shutdown(self, timeout: float = 30.0) -> None:
+        """Abort queues (no-op after a clean drain — every stage already
+        exited) and join all stage threads; publishes queue gauges."""
+        for q in self._queues:
+            q.abort()
+        for s in self._stages:
+            s.join(timeout=timeout)
+        from racon_tpu.obs.metrics import record_queue
+        from racon_tpu.obs.trace import get_tracer
+        tracer = get_tracer()
+        for q in self._queues:
+            m = q.metrics()
+            record_queue(q.name, m["peak"], float(m["put_wait_s"]),
+                         float(m["get_wait_s"]))
+            tracer.point("queue", q.name, peak=m["peak"],
+                         capacity=m["capacity"], items=m["items"],
+                         put_wait_s=m["put_wait_s"],
+                         get_wait_s=m["get_wait_s"])
+
+    def __enter__(self) -> "Pipeline":
+        return self.start()
+
+    def __exit__(self, *exc) -> bool:
+        self.shutdown()
+        return False
+
+    @property
+    def alive(self) -> bool:
+        return any(s.is_alive() for s in self._stages)

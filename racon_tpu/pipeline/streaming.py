@@ -1,0 +1,258 @@
+"""The polish-specific streaming executor.
+
+:func:`stream_consensus` runs a window list through the same per-slice
+decomposition the serial engine uses (PoaEngine._plan_device_slice — the
+two paths share the planning code, so chunk composition and therefore
+output are identical by construction), but spread over four overlapped
+stages:
+
+    build ──q──▶ pack ──q──▶ h2d ──q──▶ compute ──q──▶ (caller drains)
+                   │                                ▲
+                   └── host-path items ─────────────┘
+
+- **build** (producer): slice the window list by ``chunk``, polish
+  trivial windows (backbone consensus) inline, partition the rest into
+  device chunk groups + host-fallback windows.
+- **pack** encodes the next chunk's :class:`ChunkPlan` byte buffers
+  while the device runs the current one, and polishes host-fallback
+  windows — host consensus rides here precisely so it overlaps device
+  compute. Host items then skip straight to the done queue, which is
+  where out-of-order retirement comes from (a later slice's host item
+  can finish while an earlier slice's chunks still compute).
+- **h2d** starts the asynchronous ``device_put``
+  (device_poa.put_chunk_bufs); the ``run`` queue's capacity (=depth)
+  bounds how many chunks' input buffers sit in HBM — depth 2 is classic
+  double buffering.
+- **compute** runs the rounds (ConvergenceScheduler.run_chunk when
+  sched is on, dispatch_chunk/collect_chunk otherwise), decodes the d2h
+  pull, applies consensus to the windows, and re-polishes truncated
+  windows on the host path.
+
+The caller drains completed items; :class:`SliceTracker` releases
+contiguous leading slices in input order, so downstream FASTA emission
+streams in order no matter how items retire. All engine host-path work
+(which temporarily flips ``engine.backend``) is serialized by one lock,
+and the build stage uses a backend snapshot taken before threads start,
+so the flip can never misroute a slice.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from racon_tpu.pipeline import pipeline_depth
+from racon_tpu.pipeline.stages import Pipeline
+
+
+class _Item:
+    """One unit of pipeline work: a device chunk group or a host batch."""
+    __slots__ = ("kind", "sid", "gid", "windows", "sp", "plan", "bufs")
+
+    def __init__(self, kind: str, sid: int, windows, sp=None, gid: int = 0):
+        self.kind = kind        # "chunk" | "host"
+        self.sid = sid          # slice index (retirement unit)
+        self.gid = gid          # chunk group index within the slice
+        self.windows = windows
+        self.sp = sp            # _DeviceSlicePlan (chunk items)
+        self.plan = None        # ChunkPlan, set by the pack stage
+        self.bufs = None        # device buffers, set by the h2d stage
+
+
+class SliceTracker:
+    """Orders retirement: slices complete out of order, ranges release
+    in input order.
+
+    The build stage registers each slice (window range + item count)
+    before emitting its items; the drain loop retires items as they
+    complete. ``retire``/``flush`` return the newly releasable
+    ``(slice_id, start, end)`` ranges — always the contiguous leading
+    run of completed slices, so a consumer writing ranges as they come
+    out preserves input order unconditionally.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._left: Dict[int, int] = {}
+        self._bounds: Dict[int, Tuple[int, int]] = {}
+        self._next = 0
+
+    def register(self, sid: int, start: int, end: int,
+                 n_items: int) -> None:
+        with self._lock:
+            self._bounds[sid] = (start, end)
+            self._left[sid] = n_items
+
+    def retire(self, sid: int) -> List[Tuple[int, int, int]]:
+        with self._lock:
+            left = self._left.get(sid, 0) - 1
+            if left < 0:
+                raise RuntimeError(
+                    f"[racon_tpu::pipeline] slice {sid} retired more "
+                    "items than it registered")
+            self._left[sid] = left
+            return self._release()
+
+    def flush(self) -> List[Tuple[int, int, int]]:
+        """Release whatever completed after the stream drained cleanly;
+        a leftover incomplete slice means an item was lost — an
+        executor bug that must fail loudly, not truncate output."""
+        with self._lock:
+            out = self._release()
+            if self._bounds:
+                raise RuntimeError(
+                    f"[racon_tpu::pipeline] {len(self._bounds)} slice(s) "
+                    "never completed (lost pipeline item)")
+            return out
+
+    def _release(self) -> List[Tuple[int, int, int]]:
+        out = []
+        while self._next in self._bounds and self._left[self._next] == 0:
+            s, e = self._bounds.pop(self._next)
+            del self._left[self._next]
+            out.append((self._next, s, e))
+            self._next += 1
+        return out
+
+
+def stream_consensus(engine, windows, chunk: int = 8192,
+                     depth: Optional[int] = None,
+                     tick=None) -> Iterator[Tuple[int, int]]:
+    """Polish ``windows`` through the streaming pipeline.
+
+    Generator yielding ``(start, end)`` index ranges (ascending,
+    contiguous, covering ``range(len(windows))``) as windows finalize —
+    every window in a yielded range has its consensus applied.
+    ``depth`` bounds in-flight chunks per queue (None reads the
+    RACON_TPU_PIPELINE_DEPTH / --pipeline-depth configuration);
+    ``tick`` is called once per completed slice (progress reporting).
+
+    Abandoning the generator early tears the pipeline down cleanly
+    (queues abort, stage threads join). A stage failure re-raises here
+    as :class:`~racon_tpu.pipeline.stages.StageError`.
+    """
+    n = len(windows)
+    if n == 0:
+        return
+    if depth is None:
+        depth = pipeline_depth()
+    depth = max(1, int(depth))
+    chunk = max(1, int(chunk))
+
+    from racon_tpu.obs.metrics import record_pipeline_wall
+    from racon_tpu.obs.trace import get_tracer
+    from racon_tpu.sched import sched_enabled
+    tracer = get_tracer()
+
+    # Snapshot the backend before any thread can flip it (the host path
+    # temporarily forces "native"); all host-path work below serializes
+    # on one lock so the flip is atomic w.r.t. every reader.
+    backend_is_jax = engine.backend == "jax"
+    host_lock = threading.Lock()
+    sched = engine._make_scheduler() \
+        if backend_is_jax and sched_enabled() else None
+
+    tracker = SliceTracker()
+    pipe = Pipeline("polish")
+    q_pack = pipe.queue("pack", depth)
+    q_put = pipe.queue("put", depth)
+    q_run = pipe.queue("run", depth)
+    q_done = pipe.queue("done", max(2 * depth, 4))
+
+    def build():
+        for sid, s in enumerate(range(0, n, chunk)):
+            sl = windows[s:s + chunk]
+            active = []
+            for w in sl:
+                if w.n_layers < 2:
+                    w.set_backbone_consensus()
+                else:
+                    active.append(w)
+            items: List[_Item] = []
+            if active and backend_is_jax:
+                dev, host, lq_max, la_max = engine._partition_device(
+                    active)
+                if dev:
+                    sp = engine._plan_device_slice(dev, lq_max, la_max)
+                    if sp.overflow_msg:
+                        print(sp.overflow_msg, file=engine.log)
+                    host = host + sp.host
+                    for gi, ws in enumerate(sp.groups):
+                        items.append(_Item("chunk", sid, ws, sp=sp,
+                                           gid=gi))
+                if host:
+                    items.append(_Item("host", sid, host))
+            elif active:
+                items.append(_Item("host", sid, active))
+            # Register BEFORE emitting: an item can only retire after
+            # its slice is known to the tracker.
+            tracker.register(sid, s, min(s + chunk, n), len(items))
+            for it in items:
+                yield it
+
+    def pack(item: _Item) -> Optional[_Item]:
+        if item.kind == "host":
+            # Host consensus runs here so it overlaps device compute;
+            # the item then bypasses h2d/compute straight to done —
+            # the source of out-of-order retirement.
+            with host_lock:
+                engine._consensus_host(item.windows, force_native=True)
+            q_done.put(item)
+            return None
+        item.plan = engine._make_chunk_plan(item.sp, item.windows)
+        return item
+
+    def h2d(item: _Item) -> _Item:
+        from racon_tpu.ops.device_poa import put_chunk_bufs
+        # Async device_put: returns immediately, transfer overlaps the
+        # current chunk's compute. q_run's capacity (= depth) bounds how
+        # many chunks' input buffers are resident in HBM.
+        item.bufs = put_chunk_bufs(item.plan, mesh=engine.mesh)
+        return item
+
+    def compute(item: _Item) -> _Item:
+        from racon_tpu.ops.device_poa import collect_chunk, dispatch_chunk
+        trunc: List = []
+        with tracer.span("chunk", f"chunk{item.sid}.{item.gid}",
+                         windows=len(item.windows), lanes=item.plan.B,
+                         jobs=item.plan.n_jobs):
+            if sched is not None:
+                codes, covs = sched.run_chunk(item.plan, bufs=item.bufs)
+            else:
+                packed = dispatch_chunk(
+                    item.plan, match=engine.match,
+                    mismatch=engine.mismatch, gap=engine.gap,
+                    ins_scale=engine._round_scales(
+                        engine.refine_rounds + 1),
+                    rounds=engine.refine_rounds + 1, mesh=engine.mesh,
+                    bufs=item.bufs)
+                codes, covs = collect_chunk(item.plan, packed)
+        engine._apply_group(item.windows, codes, covs, trunc)
+        if trunc:
+            with host_lock:
+                engine._redo_trunc(trunc)
+        item.plan = item.bufs = None    # drop HBM references promptly
+        return item
+
+    pipe.source("build", build, q_pack)
+    pipe.stage("pack", pack, q_pack, q_put)
+    pipe.stage("h2d", h2d, q_put, q_run)
+    pipe.stage("compute", compute, q_run, q_done)
+
+    t0 = time.perf_counter()
+    try:
+        with tracer.span("pipeline", "stream_consensus", windows=n,
+                         depth=depth, chunk=chunk):
+            with pipe:
+                for item in pipe.drain(q_done):
+                    for _sid, s, e in tracker.retire(item.sid):
+                        if tick is not None:
+                            tick()
+                        yield (s, e)
+                for _sid, s, e in tracker.flush():
+                    if tick is not None:
+                        tick()
+                    yield (s, e)
+    finally:
+        record_pipeline_wall(time.perf_counter() - t0)

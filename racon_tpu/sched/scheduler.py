@@ -91,22 +91,8 @@ class ConvergenceScheduler:
         pipeline (its per-round host syncs preclude dispatch-level
         overlap, but h2d is the tunnel-bound phase worth hiding).
         """
-        import jax
-        job_h, win_h = plan.packed_bufs()
-        t0 = time.perf_counter()
-        if self.mesh is None:
-            bufs = tuple(jax.device_put((job_h, win_h)))
-        else:
-            from jax.sharding import NamedSharding, PartitionSpec as P
-            bufs = (jax.device_put(job_h,
-                                   NamedSharding(self.mesh, P("dp"))),
-                    jax.device_put(win_h, NamedSharding(self.mesh, P())))
-        # device_put is async here by design (the transfer overlaps the
-        # previous chunk's rounds): the recorded seconds cover only the
-        # synchronous serialization/enqueue portion.
-        record_h2d(job_h.nbytes + win_h.nbytes, time.perf_counter() - t0,
-                   name="h2d/chunk")
-        return bufs
+        from racon_tpu.ops.device_poa import put_chunk_bufs
+        return put_chunk_bufs(plan, mesh=self.mesh)
 
     # ------------------------------------------------------------------ run
 

@@ -14,11 +14,16 @@ Two extensions over the reference:
 - Every completed phase is also emitted as a ``phase`` span through the
   structured tracer (racon_tpu/obs/trace.py) — a no-op unless
   RACON_TPU_TRACE / --trace is set.
+- Output is serialized by a per-logger lock so pipeline stage threads
+  (racon_tpu/pipeline/) can share one logger without interleaving
+  mid-line; :meth:`with_prefix` hands a stage a tagged view that shares
+  the parent's lock, timers, and bar state.
 """
 
 from __future__ import annotations
 
 import sys
+import threading
 import time
 
 
@@ -30,6 +35,7 @@ class Logger:
             self._tty = bool(isatty()) if isatty is not None else False
         except Exception:
             self._tty = False
+        self._lock = threading.Lock()
         self._t0 = time.perf_counter()
         self._phase_t0 = self._t0
         self._bar = 0          # progress position, 0..20
@@ -37,8 +43,9 @@ class Logger:
 
     def begin(self) -> None:
         """Start/reset the phase timer — the reference's ``(*logger)()``."""
-        self._phase_t0 = time.perf_counter()
-        self._bar = 0
+        with self._lock:
+            self._phase_t0 = time.perf_counter()
+            self._bar = 0
 
     def _close_bar(self) -> None:
         """End a partially drawn '\\r' bar line so the next print starts
@@ -49,40 +56,83 @@ class Logger:
 
     def phase(self, msg: str) -> None:
         """Print elapsed phase time — the reference's ``(*logger)("msg")``."""
-        self._close_bar()
-        self._bar = 0
-        elapsed = time.perf_counter() - self._phase_t0
-        print(f"{msg} {elapsed:.6f} s", file=self.stream)
+        with self._lock:
+            self._close_bar()
+            self._bar = 0
+            elapsed = time.perf_counter() - self._phase_t0
+            print(f"{msg} {elapsed:.6f} s", file=self.stream)
         from racon_tpu.obs.trace import get_tracer
         get_tracer().emit("phase", msg, self._phase_t0, elapsed)
 
     def tick(self, msg: str) -> None:
         """Advance a 20-step progress bar — ``(*logger)["msg"]``."""
-        self._bar = min(self._bar + 1, 20)
-        bar = "=" * self._bar + " " * (20 - self._bar)
-        elapsed = time.perf_counter() - self._phase_t0
-        if self._tty:
-            end = "\n" if self._bar == 20 else ""
-            print(f"\r{msg} [{bar}] {elapsed:.6f} s", end=end,
-                  file=self.stream, flush=True)
-            self._bar_open = self._bar != 20
-        else:
-            # Non-TTY: '\r' never erases, so a redrawn bar would land as
-            # one garbled mega-line; print a complete line per tick.
-            print(f"{msg} [{bar}] {elapsed:.6f} s", file=self.stream,
-                  flush=True)
-        if self._bar == 20:
-            self._bar = 0
+        with self._lock:
+            self._bar = min(self._bar + 1, 20)
+            bar = "=" * self._bar + " " * (20 - self._bar)
+            elapsed = time.perf_counter() - self._phase_t0
+            if self._tty:
+                end = "\n" if self._bar == 20 else ""
+                print(f"\r{msg} [{bar}] {elapsed:.6f} s", end=end,
+                      file=self.stream, flush=True)
+                self._bar_open = self._bar != 20
+            else:
+                # Non-TTY: '\r' never erases, so a redrawn bar would land
+                # as one garbled mega-line; print a complete line per tick.
+                print(f"{msg} [{bar}] {elapsed:.6f} s", file=self.stream,
+                      flush=True)
+            if self._bar == 20:
+                self._bar = 0
 
     def line(self, msg: str) -> None:
         """Print a plain diagnostic line (closing any partial bar)."""
-        self._close_bar()
-        print(msg, file=self.stream)
+        with self._lock:
+            self._close_bar()
+            print(msg, file=self.stream)
 
     def total(self, msg: str) -> None:
         """Print total wall time — the reference's ``logger->total()``."""
-        elapsed = time.perf_counter() - self._t0
-        print(f"{msg} {elapsed:.6f} s", file=self.stream)
+        with self._lock:
+            elapsed = time.perf_counter() - self._t0
+            print(f"{msg} {elapsed:.6f} s", file=self.stream)
+
+    def with_prefix(self, prefix: str) -> "Logger":
+        """A view of this logger that prefixes every message — lets a
+        pipeline stage tag its output (``log.with_prefix("[pack] ")``)
+        while sharing the parent's lock, timers, and bar state, so
+        concurrent stages never interleave mid-line."""
+        return _PrefixLogger(self, prefix)
+
+
+class _PrefixLogger:
+    """with_prefix view: delegates to the parent with tagged messages."""
+
+    __slots__ = ("_parent", "_prefix")
+
+    def __init__(self, parent: Logger, prefix: str):
+        self._parent = parent
+        self._prefix = prefix
+
+    @property
+    def stream(self):
+        return self._parent.stream
+
+    def begin(self) -> None:
+        self._parent.begin()
+
+    def phase(self, msg: str) -> None:
+        self._parent.phase(self._prefix + msg)
+
+    def tick(self, msg: str) -> None:
+        self._parent.tick(self._prefix + msg)
+
+    def line(self, msg: str) -> None:
+        self._parent.line(self._prefix + msg)
+
+    def total(self, msg: str) -> None:
+        self._parent.total(self._prefix + msg)
+
+    def with_prefix(self, prefix: str) -> "_PrefixLogger":
+        return _PrefixLogger(self._parent, self._prefix + prefix)
 
 
 class NullLogger(Logger):
@@ -105,6 +155,9 @@ class NullLogger(Logger):
 
     def total(self, msg: str) -> None:
         pass
+
+    def with_prefix(self, prefix: str) -> "NullLogger":
+        return self
 
 
 class _NullStream:

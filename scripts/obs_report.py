@@ -23,6 +23,15 @@ from typing import Dict, List, Optional
 
 REQUIRED_SPAN_KEYS = ("id", "parent", "kind", "name", "t0", "dur_s")
 
+#: Per-kind attribute contract (docs/OBSERVABILITY.md): spans of these
+#: kinds must carry the listed attrs or downstream aggregation (the
+#: transfer table, the pipeline section) silently under-counts.
+KIND_REQUIRED_ATTRS = {
+    "transfer": ("bytes", "dir"),
+    "stage": ("items", "busy_s", "stall_s"),
+    "queue": ("peak", "capacity", "items"),
+}
+
 # Span intervals are rounded to 1e-6 on write and a parent's clock stops
 # fractionally after its children's; allow that much slack in nesting.
 EPS = 5e-3
@@ -77,6 +86,10 @@ def validate(tr: Dict[str, object]) -> List[str]:
             if not isinstance(v, (int, float)) or v < 0:
                 errs.append(f"span {sid}: {k} must be a non-negative "
                             f"number, got {v!r}")
+        for k in KIND_REQUIRED_ATTRS.get(s.get("kind"), ()):
+            if k not in s:
+                errs.append(f"span {sid}: kind {s.get('kind')!r} missing "
+                            f"attr {k!r}")
         parent = s.get("parent")
         if parent is not None:
             p = spans.get(parent)
@@ -123,7 +136,8 @@ def render(tr: Dict[str, object], out=sys.stdout) -> None:
     for s in spans.values():
         by_kind.setdefault(s["kind"], []).append(s)
 
-    for kind in ("phase", "chunk", "round", "dispatch"):
+    for kind in ("phase", "pipeline", "stage", "chunk", "round",
+                 "dispatch"):
         rows = by_kind.get(kind)
         if not rows:
             continue
@@ -165,11 +179,63 @@ def render(tr: Dict[str, object], out=sys.stdout) -> None:
               f"of run wall", file=out)
 
     m = tr["metrics"]
+    _render_pipeline(m, out)
     if m:
         keys = [k for k in sorted(m) if k != "ev"]
         print("\nmetrics:", file=out)
         for k in keys:
             print(f"  {k} = {m[k]}", file=out)
+
+
+_STAGE_SUFFIXES = ("_busy_s", "_stall_in_s", "_stall_out_s", "_items")
+_QUEUE_SUFFIXES = ("_peak", "_put_wait_s", "_get_wait_s")
+
+
+def _pipe_names(m: dict, prefix: str, suffixes) -> List[str]:
+    names = set()
+    for k in m:
+        if not k.startswith(prefix):
+            continue
+        for suf in suffixes:
+            if k.endswith(suf):
+                names.add(k[len(prefix):-len(suf)])
+    return sorted(names)
+
+
+def _render_pipeline(m, out) -> None:
+    """The "Pipeline" section: per-stage busy/stall, per-queue gauges,
+    and overlap efficiency (device-busy / pipeline wall), all from the
+    ``pipe_*`` metrics the streaming executor records."""
+    if not m or not int(m.get("pipe_runs", 0) or 0):
+        return
+    wall = float(m.get("pipe_wall_s", 0.0))
+    print(f"\npipeline: runs={int(m['pipe_runs'])}  wall={wall:.3f}s",
+          file=out)
+    stages = _pipe_names(m, "pipe_stage_", _STAGE_SUFFIXES)
+    if stages:
+        print(f"{'stage':>8}  {'items':>5}  {'busy_s':>9}  "
+              f"{'stall_in':>9}  {'stall_out':>9}", file=out)
+        for name in stages:
+            g = lambda suf: m.get(f"pipe_stage_{name}{suf}", 0)  # noqa: E731
+            print(f"{name:>8}  {int(g('_items')):>5}  "
+                  f"{float(g('_busy_s')):>9.3f}  "
+                  f"{float(g('_stall_in_s')):>9.3f}  "
+                  f"{float(g('_stall_out_s')):>9.3f}", file=out)
+    queues = _pipe_names(m, "pipe_queue_", _QUEUE_SUFFIXES)
+    if queues:
+        print(f"{'queue':>8}  {'peak':>5}  {'put_wait':>9}  "
+              f"{'get_wait':>9}", file=out)
+        for name in queues:
+            g = lambda suf: m.get(f"pipe_queue_{name}{suf}", 0)  # noqa: E731
+            print(f"{name:>8}  {int(g('_peak')):>5}  "
+                  f"{float(g('_put_wait_s')):>9.3f}  "
+                  f"{float(g('_get_wait_s')):>9.3f}", file=out)
+    eff = m.get("pipe_overlap_efficiency")
+    if eff is None and wall > 0:
+        eff = float(m.get("pipe_stage_compute_busy_s", 0.0)) / wall
+    if eff is not None:
+        print(f"overlap efficiency: {float(eff):.3f} "
+              "(compute busy / pipeline wall)", file=out)
 
 
 def main(argv: Optional[List[str]] = None) -> int:

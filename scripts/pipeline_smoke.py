@@ -1,0 +1,81 @@
+"""CI smoke: tiny polish through the streaming pipeline, trace-gated.
+
+Runs the real CLI path twice on a synthetic contig — serial
+(RACON_TPU_PIPELINE=0) and streamed (--pipeline-depth 2) — asserts the
+polished FASTA is byte-identical (the pipeline's core contract), then
+validates the streamed run's trace against the documented schema
+(pipeline/stage/queue span kinds and their required attrs —
+scripts/obs_report.py --validate logic) and checks the pipe_* gauges
+landed in the metrics footer.
+"""
+
+import contextlib
+import io
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from racon_tpu import cli                            # noqa: E402
+from scripts import obs_report                       # noqa: E402
+from scripts.obs_smoke import _write_inputs          # noqa: E402
+
+
+def _run_cli(d, *extra, trace=None):
+    if trace is not None:
+        os.environ["RACON_TPU_TRACE"] = trace
+    else:
+        os.environ.pop("RACON_TPU_TRACE", None)
+
+    class _Capture(io.StringIO):
+        pass
+
+    stdout = _Capture()
+    stdout.buffer = io.BytesIO()
+    with contextlib.redirect_stdout(stdout):
+        rc = cli.main(["--backend", "jax", *extra,
+                       os.path.join(d, "reads.fasta"),
+                       os.path.join(d, "ovl.paf"),
+                       os.path.join(d, "draft.fasta")])
+    assert rc == 0, f"cli exited {rc}"
+    return stdout.buffer.getvalue()
+
+
+def main():
+    with tempfile.TemporaryDirectory() as d:
+        _write_inputs(d)
+
+        os.environ["RACON_TPU_PIPELINE"] = "0"
+        serial = _run_cli(d)
+        assert serial.startswith(b">c1 LN:i:"), "no polished FASTA"
+
+        os.environ.pop("RACON_TPU_PIPELINE", None)
+        trace = os.path.join(d, "trace.jsonl")
+        from racon_tpu.obs import metrics as obs_metrics
+        obs_metrics.reset()
+        streamed = _run_cli(d, "--pipeline-depth", "2", trace=trace)
+        os.environ.pop("RACON_TPU_TRACE", None)
+
+        assert streamed == serial, \
+            "pipelined FASTA differs from serial output"
+
+        tr = obs_report.load_trace(trace)
+        errs = obs_report.validate(tr)
+        assert not errs, "trace schema violations:\n" + "\n".join(errs)
+        kinds = {s["kind"] for s in tr["spans"].values()}
+        for want in ("run", "pipeline", "stage", "queue", "chunk"):
+            assert want in kinds, f"no {want!r} span in trace ({kinds})"
+        m = tr["metrics"]
+        assert m is not None, "no metrics footer"
+        assert m.get("pipe_runs", 0) >= 1, "no pipeline accounting"
+        assert "pipe_stage_compute_busy_s" in m, "no stage gauges"
+        assert "pipe_overlap_efficiency" in m, "no overlap efficiency"
+        print(f"[pipeline-smoke] trace ok: {len(tr['spans'])} spans, "
+              f"kinds={sorted(kinds)}, overlap_eff="
+              f"{m['pipe_overlap_efficiency']}", flush=True)
+    print("[pipeline-smoke] PASS", flush=True)
+
+
+if __name__ == "__main__":
+    main()

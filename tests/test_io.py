@@ -112,6 +112,80 @@ def test_sam_reverse_strand_flips_query_coords(tmp_path):
     assert (o.q_begin, o.q_end) == (0, 10)
 
 
+def test_fastq_truncated_record_reports_offset(tmp_path):
+    """EOF inside a FASTQ record must name the file AND the byte offset
+    of the record that was cut, so a truncated download is diagnosable
+    without bisecting the file by hand."""
+    p = tmp_path / "trunc.fastq"
+    good = b"@r1\nACGT\n+\nIIII\n"
+    p.write_bytes(good + b"@r2\nACGT\n")  # record cut before '+'
+    with pytest.raises(ParseError,
+                       match=r"EOF inside the record starting.*"
+                             r"at byte offset 16") as ei:
+        FastqParser(str(p)).parse_all()
+    assert ei.value.offset == len(good)
+
+
+def test_fastq_malformed_quality_reports_offset(tmp_path):
+    p = tmp_path / "bad.fastq"
+    good = b"@r1\nACGT\n+\nIIII\n"
+    p.write_bytes(good + b"@r2\nACGT\n+\nII I\n")
+    with pytest.raises(ParseError, match="malformed quality") as ei:
+        FastqParser(str(p)).parse_all()
+    assert ei.value.offset == len(good)
+
+
+def test_fasta_malformed_reports_offset(tmp_path):
+    p = tmp_path / "bad.fasta"
+    p.write_bytes(b"ACGT\n")  # data before any header
+    with pytest.raises(ParseError, match="at byte offset 0") as ei:
+        FastaParser(str(p)).parse_all()
+    assert ei.value.offset == 0
+
+
+def test_overlap_parsers_report_offset(tmp_path):
+    good = "q1\t100\t5\t95\t-\tt1\t200\t10\t110\t80\t90\t60\n"
+    p = tmp_path / "bad.paf"
+    p.write_text(good + "short\tline\n")
+    with pytest.raises(ParseError, match="malformed PAF") as ei:
+        PafParser(str(p)).parse_all()
+    assert ei.value.offset == len(good)
+    m = tmp_path / "bad.mhap"
+    m.write_text("1 2 0.05\n")
+    with pytest.raises(ParseError, match="malformed MHAP") as ei:
+        MhapParser(str(m)).parse_all()
+    assert ei.value.offset == 0
+    s = tmp_path / "bad.sam"
+    s.write_text("@HD\tVN:1.6\nr1\tonly\tthree\n")
+    with pytest.raises(ParseError, match="malformed SAM") as ei:
+        SamParser(str(s)).parse_all()
+    assert ei.value.offset == len("@HD\tVN:1.6\n")
+
+
+def test_interleaved_chunked_parsers_stay_independent(tmp_path):
+    """Two parsers chunk-reading concurrently (the streaming pipeline's
+    parse stage interleaves sequences and overlaps) must not share or
+    corrupt state: each record owns fresh immutable bytes."""
+    a = tmp_path / "a.fasta"
+    b = tmp_path / "b.fasta"
+    a.write_text("".join(f">a{i}\n{'ACGT' * 50}\n" for i in range(8)))
+    b.write_text("".join(f">b{i}\n{'TTAA' * 50}\n" for i in range(8)))
+    pa, pb = FastaParser(str(a)), FastaParser(str(b))
+    out_a, out_b = [], []
+    more_a = more_b = True
+    while more_a or more_b:
+        if more_a:
+            recs, more_a = pa.parse(max_bytes=300)
+            out_a.extend(recs)
+        if more_b:
+            recs, more_b = pb.parse(max_bytes=300)
+            out_b.extend(recs)
+    assert [s.name for s in out_a] == [f"a{i}" for i in range(8)]
+    assert [s.name for s in out_b] == [f"b{i}" for i in range(8)]
+    assert all(s.data == b"ACGT" * 50 for s in out_a)
+    assert all(s.data == b"TTAA" * 50 for s in out_b)
+
+
 def test_extension_dispatch_errors(tmp_path):
     bad = tmp_path / "x.txt"
     bad.write_text("")

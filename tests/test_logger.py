@@ -102,6 +102,50 @@ def test_bar_resets_per_phase():
     assert "[=                   ]" in s.getvalue().splitlines()[-1]
 
 
+def test_with_prefix_tags_messages_and_shares_state():
+    s = io.StringIO()
+    log = Logger(stream=s)
+    view = log.with_prefix("[pack] ")
+    view.line("starting")
+    log.line("plain")
+    nested = view.with_prefix("sub: ")
+    nested.line("deep")
+    lines = s.getvalue().splitlines()
+    assert lines == ["[pack] starting", "plain", "[pack] sub: deep"]
+    # The view shares the parent's stream (and therefore its lock).
+    assert view.stream is log.stream
+
+
+def test_with_prefix_is_thread_safe():
+    """Concurrent stages ticking through prefixed views must never
+    interleave mid-line — every emitted line is exactly one tick."""
+    import threading
+    s = io.StringIO()
+    log = Logger(stream=s)
+
+    def work(tag):
+        v = log.with_prefix(f"[{tag}] ")
+        for _ in range(50):
+            v.tick("working")
+
+    threads = [threading.Thread(target=work, args=(t,))
+               for t in ("a", "b", "c")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    lines = s.getvalue().splitlines()
+    assert len(lines) == 150
+    assert all(re.fullmatch(
+        r"\[[abc]\] working \[[= ]{20}\] \d+\.\d{6} s", ln)
+        for ln in lines), lines[:5]
+
+
+def test_null_logger_with_prefix_is_self():
+    log = NullLogger()
+    assert log.with_prefix("[x] ") is log
+
+
 def test_null_logger_is_silent_and_safe():
     log = NullLogger()
     log.begin()
