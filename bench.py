@@ -33,6 +33,7 @@ estimated), so value / 160 rides along as vs_ref_spoa_64t_est.
 """
 
 import json
+import os
 import sys
 import time
 
@@ -216,8 +217,14 @@ def main():
     # both are reported.
     from racon_tpu.utils.jaxcache import cache_extras
     extras = {**sched_extras, **e2e_transfers, **pipe_extras,
-              **cache_extras()}
+              **cache_extras(), **obs_metrics.resilience_extras()}
     out = {
+        # metric_version 5: same primary value as versions 2/3/4. New
+        # in 5: res_* resilience extras (retry/fault/degradation/
+        # checkpoint counters from racon_tpu/resilience/) ride along —
+        # all zero/absent on a healthy bench, non-empty when
+        # RACON_TPU_FAULTS or retry activity occurred, so a perf number
+        # produced under degradation is visibly flagged.
         # metric_version 4: same primary value as versions 2/3
         # (compute-only windows/s of a warm production chunk — the
         # convergence scheduler's dispatch chain when RACON_TPU_SCHED is
@@ -232,7 +239,7 @@ def main():
         # fixed_engine_windows_per_sec. Bump this whenever the primary
         # value's definition changes, so round-over-round comparisons
         # can't silently mix metrics.
-        "metric_version": 4,
+        "metric_version": 5,
         "metric": f"POA windows/sec/chip, compute-only (direct-timed warm "
                   f"production chunk, convergence-scheduled refinement "
                   f"rounds — racon_tpu/sched/, telemetry in sched_* "
@@ -263,6 +270,13 @@ def main():
         **extras,
     }
     print(json.dumps(out))
+    # RACON_TPU_BENCH_OUT=<path>: also persist the record durably. The
+    # atomic write means a bench killed mid-emission leaves the previous
+    # artifact intact rather than a torn JSON file.
+    out_path = os.environ.get("RACON_TPU_BENCH_OUT", "")
+    if out_path:
+        from racon_tpu.utils.atomicio import atomic_write_text
+        atomic_write_text(out_path, json.dumps(out) + "\n")
     tracer.finish(metrics={**obs_metrics.registry().snapshot(),
                            "bench_value": out["value"]})
 

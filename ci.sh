@@ -46,4 +46,7 @@ python scripts/obs_smoke.py
 echo "[ci] pipeline smoke (streamed == serial FASTA + pipe span/gauge gate)"
 python scripts/pipeline_smoke.py
 
+echo "[ci] resilience smoke (injected faults + kill-and-resume byte-diff)"
+python scripts/resilience_smoke.py
+
 echo "[ci] OK"

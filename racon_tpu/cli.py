@@ -15,6 +15,17 @@ from racon_tpu import __version__
 
 _USAGE = "racon_tpu [options ...] <sequences> <overlaps> <target sequences>"
 
+
+class _Interrupted(Exception):
+    """SIGINT/SIGTERM re-raised as an exception so teardown runs in
+    order: pipeline abort-cascade (generator close), checkpoint store
+    close (commits are already fsync'd), trace finalization — then a
+    conventional 128+signum exit instead of a traceback."""
+
+    def __init__(self, signum: int):
+        super().__init__(f"signal {signum}")
+        self.signum = signum
+
 _DESCRIPTION = """\
     <sequences>
         input file in FASTA/FASTQ format (can be compressed with gzip)
@@ -84,6 +95,16 @@ def build_parser() -> argparse.ArgumentParser:
                          "(same as RACON_TPU_TRACE=PATH; render with "
                          "scripts/obs_report.py — see "
                          "docs/OBSERVABILITY.md)")
+    ap.add_argument("--checkpoint-dir", metavar="DIR", default=None,
+                    help="checkpoint each polished contig into DIR "
+                         "(FASTA shard + manifest, fsync'd per commit) "
+                         "so a killed run can continue with --resume "
+                         "(see docs/RESILIENCE.md)")
+    ap.add_argument("--resume", action="store_true",
+                    help="continue from --checkpoint-dir: committed "
+                         "contigs re-emit byte-identically from the "
+                         "shard, only the rest recompute; refuses if "
+                         "inputs or output-affecting options changed")
     ap.add_argument("--version", action="store_true",
                     help="prints the version number")
     ap.add_argument("-h", "--help", action="store_true",
@@ -158,6 +179,53 @@ def main(argv: Optional[List[str]] = None) -> int:
         mesh = Mesh(_np.asarray(devs[:ndp]), ("dp",))
 
     out = sys.stdout.buffer
+    store = None
+    if args.resume and not args.checkpoint_dir:
+        print("[racon_tpu::] error: --resume requires --checkpoint-dir!",
+              file=sys.stderr)
+        return 1
+    if args.checkpoint_dir:
+        from racon_tpu.resilience.checkpoint import (CheckpointError,
+                                                     CheckpointStore,
+                                                     run_fingerprint)
+        # Everything that changes emitted bytes goes into the
+        # fingerprint; backend/mesh/pipeline knobs are excluded because
+        # the execution paths are bit-identical by design.
+        ckpt_config = {
+            "version": __version__,
+            "include_unpolished": bool(args.include_unpolished),
+            "fragment_correction": bool(args.fragment_correction),
+            "window_length": args.window_length,
+            "quality_threshold": args.quality_threshold,
+            "error_threshold": args.error_threshold,
+            "match": args.match,
+            "mismatch": args.mismatch,
+            "gap": args.gap,
+        }
+        try:
+            fp = run_fingerprint(ckpt_config, args.paths[:3])
+            store = (CheckpointStore.resume(args.checkpoint_dir, fp)
+                     if args.resume else
+                     CheckpointStore.create(args.checkpoint_dir, fp))
+        except (CheckpointError, OSError) as exc:
+            print(str(exc), file=sys.stderr)
+            return 1
+        if args.resume and store.committed:
+            print(f"[racon_tpu::] resuming: {len(store.committed)} "
+                  f"contig(s) already committed in "
+                  f"{args.checkpoint_dir}", file=sys.stderr)
+
+    import signal
+    import threading
+    old_handlers = {}
+    if threading.current_thread() is threading.main_thread():
+        def _on_signal(signum, frame):
+            raise _Interrupted(signum)
+        for s in (signal.SIGINT, signal.SIGTERM):
+            old_handlers[s] = signal.signal(s, _on_signal)
+
+    from racon_tpu.obs.metrics import record_ckpt
+    from racon_tpu.obs.metrics import registry as obs_registry
     try:
         with tracer.span("run", "racon_tpu"):
             polisher = create_polisher(
@@ -169,25 +237,70 @@ def main(argv: Optional[List[str]] = None) -> int:
                 backend=args.backend, logger=logger, threads=args.threads,
                 mesh=mesh)
             polisher.initialize()
-            if pipeline_enabled():
-                # Streaming path: each contig is written the moment its
-                # last window retires, while later windows still flow
-                # through the pipeline — emission overlaps compute.
-                for seq in polisher.polish_stream(
-                        not args.include_unpolished):
-                    out.write(b">" + seq.name.encode() + b"\n" +
-                              seq.data + b"\n")
-            else:
-                for seq in polisher.polish(not args.include_unpolished):
-                    out.write(b">" + seq.name.encode() + b"\n" +
-                              seq.data + b"\n")
+            if store is not None and store.committed:
+                n_skip = polisher.skip_targets(store.committed)
+                if n_skip:
+                    print("[racon_tpu::] resume: skipping recompute of "
+                          f"{n_skip} window(s)", file=sys.stderr)
+            n_targets = polisher._targets_size
+            next_tid = 0
+
+            def emit_stored(limit: int) -> None:
+                # Re-emit committed contigs (exact shard bytes) for
+                # every target slot before `limit` — interleaving
+                # stored and freshly polished targets in input order
+                # keeps resumed stdout byte-identical to a fresh run.
+                nonlocal next_tid
+                while next_tid < limit:
+                    if store is not None and \
+                            next_tid in store.committed:
+                        blob = store.read_emitted(next_tid)
+                        if blob is not None:
+                            out.write(blob)
+                        record_ckpt("skip", next_tid,
+                                    len(blob) if blob else 0)
+                    next_tid += 1
+
+            # Each contig is written the moment its last window
+            # retires (with the pipeline on, while later windows still
+            # flow through it — emission overlaps compute), then
+            # durably committed before the next one is handled.
+            for tid, rec in polisher.polish_records(
+                    not args.include_unpolished):
+                emit_stored(tid)
+                if rec is not None:
+                    out.write(b">" + rec.name.encode() + b"\n" +
+                              rec.data + b"\n")
+                if store is not None:
+                    if rec is not None:
+                        store.commit(tid, rec.name.encode(), rec.data)
+                    else:
+                        store.commit_dropped(tid)
+                next_tid = tid + 1
+            emit_stored(n_targets)
     except (PolisherError, ParseError, ValueError) as exc:
         print(str(exc), file=sys.stderr)
         return 1
+    except _Interrupted as exc:
+        out.flush()
+        if store is not None:
+            print(f"[racon_tpu::] interrupted (signal {exc.signum}); "
+                  f"{len(store.committed)} contig(s) committed in "
+                  f"{args.checkpoint_dir} — rerun with --resume",
+                  file=sys.stderr)
+        else:
+            print(f"[racon_tpu::] interrupted (signal {exc.signum})",
+                  file=sys.stderr)
+        tracer.finish(metrics=obs_registry().snapshot())
+        return 128 + exc.signum
+    finally:
+        for s, h in old_handlers.items():
+            signal.signal(s, h)
+        if store is not None:
+            store.close()
     out.flush()
     logger.total("[racon_tpu::Polisher::] total =")
     from racon_tpu.obs.metrics import pipeline_extras
-    from racon_tpu.obs.metrics import registry as obs_registry
     from racon_tpu.utils.jaxcache import cache_extras
     reg = obs_registry()
     for k, v in cache_extras(reg).items():

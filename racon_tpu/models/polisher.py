@@ -328,38 +328,84 @@ class Polisher:
 
     # ----------------------------------------------------------------- polish
 
+    def skip_targets(self, committed) -> int:
+        """Drop every window of the given target ids before polishing —
+        the checkpoint-resume path (racon_tpu/resilience/checkpoint.py):
+        committed contigs re-emit from the shard, so their windows must
+        not recompute. Pruning whole targets is safe for the assembler:
+        each contig's windows restart at rank 0, so the remaining
+        boundary structure is unchanged. Returns #windows dropped.
+        """
+        committed = set(committed)
+        if not committed:
+            return 0
+        keep = [w for w in self.windows if w.id not in committed]
+        n = len(self.windows) - len(keep)
+        self.windows = keep
+        return n
+
+    def polish_records(self, drop_unpolished_sequences: bool = True):
+        """The one polishing loop: yield ``(target_id, record-or-None)``
+        as each target's last window finalizes, in target input order.
+
+        ``record`` is None for a target dropped as unpolished — the
+        completion event still yields so a checkpointing caller can
+        commit the drop (resume must skip its compute too). polish()
+        and polish_stream() are thin views over this; the serial and
+        streaming executors feed the same assembler, so the two paths
+        stay bit-identical by construction.
+        """
+        from racon_tpu.pipeline import pipeline_enabled
+        log = self.logger
+        log.begin()
+        asm = _ContigAssembler(self, drop_unpolished_sequences)
+
+        if pipeline_enabled():
+            from racon_tpu.pipeline import pipeline_depth
+            from racon_tpu.pipeline.streaming import stream_consensus
+
+            def _tick():
+                log.tick(
+                    "[racon_tpu::Polisher::polish] generating consensus")
+
+            for s, e in stream_consensus(self.engine, self.windows,
+                                         chunk=self.window_chunk,
+                                         depth=pipeline_depth(),
+                                         tick=_tick):
+                for i in range(s, e):
+                    done = asm.feed(i, self.windows[i])
+                    if done is not None:
+                        yield done
+            self._log_sched_summary()
+        else:
+            n_windows = len(self.windows)
+            for s in range(0, n_windows, self.window_chunk):
+                self.engine.consensus_windows(
+                    self.windows[s:s + self.window_chunk])
+                log.tick(
+                    "[racon_tpu::Polisher::polish] generating consensus")
+            self._log_sched_summary()
+            for i, w in enumerate(self.windows):
+                done = asm.feed(i, w)
+                if done is not None:
+                    yield done
+
+        log.phase("[racon_tpu::Polisher::polish] generated consensus")
+        self.windows = []
+
     def polish(self, drop_unpolished_sequences: bool = True
                ) -> List[PolishedSequence]:
         """Batch windows through the engine, stitch contigs in order, tag
         and emit (src/polisher.cpp:451-513).
 
         With the streaming pipeline enabled (RACON_TPU_PIPELINE /
-        --pipeline-depth; racon_tpu/pipeline/) this delegates to
-        :meth:`polish_stream` — same records, bit-identical, just
-        produced through the overlapped executor.
+        --pipeline-depth; racon_tpu/pipeline/) the underlying
+        :meth:`polish_records` loop runs the overlapped executor — same
+        records, bit-identical.
         """
-        from racon_tpu.pipeline import pipeline_enabled
-        if pipeline_enabled():
-            return list(self.polish_stream(drop_unpolished_sequences))
-        log = self.logger
-        log.begin()
-
-        n_windows = len(self.windows)
-        for s in range(0, n_windows, self.window_chunk):
-            self.engine.consensus_windows(self.windows[s:s + self.window_chunk])
-            log.tick("[racon_tpu::Polisher::polish] generating consensus")
-        self._log_sched_summary()
-
-        asm = _ContigAssembler(self, drop_unpolished_sequences)
-        dst: List[PolishedSequence] = []
-        for i, w in enumerate(self.windows):
-            rec = asm.feed(i, w)
-            if rec is not None:
-                dst.append(rec)
-
-        log.phase("[racon_tpu::Polisher::polish] generated consensus")
-        self.windows = []
-        return dst
+        return [rec for _tid, rec
+                in self.polish_records(drop_unpolished_sequences)
+                if rec is not None]
 
     def polish_stream(self, drop_unpolished_sequences: bool = True):
         """Streaming polish: yield each PolishedSequence as soon as all
@@ -371,27 +417,9 @@ class Polisher:
         input order, so records come out exactly as polish() would list
         them — the two are differentially tested bit-identical.
         """
-        log = self.logger
-        log.begin()
-        from racon_tpu.pipeline import pipeline_depth
-        from racon_tpu.pipeline.streaming import stream_consensus
-
-        asm = _ContigAssembler(self, drop_unpolished_sequences)
-
-        def _tick():
-            log.tick("[racon_tpu::Polisher::polish] generating consensus")
-
-        for s, e in stream_consensus(self.engine, self.windows,
-                                     chunk=self.window_chunk,
-                                     depth=pipeline_depth(), tick=_tick):
-            for i in range(s, e):
-                rec = asm.feed(i, self.windows[i])
-                if rec is not None:
-                    yield rec
-
-        self._log_sched_summary()
-        log.phase("[racon_tpu::Polisher::polish] generated consensus")
-        self.windows = []
+        for _tid, rec in self.polish_records(drop_unpolished_sequences):
+            if rec is not None:
+                yield rec
 
     def _log_sched_summary(self) -> None:
         telem = getattr(self.engine, "sched_telemetry", None)
@@ -408,11 +436,12 @@ class Polisher:
 
 class _ContigAssembler:
     """Incremental contig stitching: feed finalized windows in input
-    order; the last window of each target returns the stitched, tagged
-    PolishedSequence (or None when dropped as unpolished). One
-    implementation serves polish() and polish_stream() so the record
-    format cannot drift between the serial and streaming paths
-    (src/polisher.cpp:478-508)."""
+    order; the last window of each target returns ``(target_id,
+    PolishedSequence-or-None)`` — None when the target is dropped as
+    unpolished, so completion is still observable (the checkpoint store
+    commits drops too). One implementation serves every polish path so
+    the record format cannot drift between the serial and streaming
+    executors (src/polisher.cpp:478-508)."""
 
     __slots__ = ("p", "drop", "n_windows", "_data", "_num_polished")
 
@@ -423,7 +452,8 @@ class _ContigAssembler:
         self._data: List[bytes] = []
         self._num_polished = 0
 
-    def feed(self, i: int, w: Window) -> Optional[PolishedSequence]:
+    def feed(self, i: int, w: Window
+             ) -> Optional[Tuple[int, Optional[PolishedSequence]]]:
         p = self.p
         self._num_polished += 1 if w.polished else 0
         self._data.append(w.consensus or b"")
@@ -441,7 +471,7 @@ class _ContigAssembler:
             rec = PolishedSequence(p.sequences[w.id].name + tags, data)
         self._num_polished = 0
         self._data = []
-        return rec
+        return (w.id, rec)
 
 
 def _filter_overlap_group(group: List[Overlap], error_threshold: float,

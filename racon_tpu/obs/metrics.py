@@ -158,6 +158,82 @@ def transfer_extras(reg: Optional[MetricsRegistry] = None
     return out
 
 
+# ----------------------------------------------------------- resilience
+
+def _site_key(site: str) -> str:
+    """Metric-key slug for a call-site name ("h2d/chunk" -> "h2d_chunk")."""
+    return site.replace("/", "_").replace(".", "_")
+
+
+def record_retry(site: str, attempt: int, delay_s: float, error: str,
+                 injected: bool,
+                 reg: Optional[MetricsRegistry] = None) -> None:
+    """Account one retried attempt at a resilience-wrapped call site
+    (racon_tpu/resilience/retry.py) and trace it as a ``retry`` span."""
+    reg = reg if reg is not None else _REGISTRY
+    reg.inc("res_retry_total")
+    reg.inc(f"res_retry_site_{_site_key(site)}")
+    reg.inc("res_retry_backoff_s", float(delay_s))
+    _trace.get_tracer().point("retry", site, attempt=int(attempt),
+                              error=error, injected=int(bool(injected)))
+
+
+def record_retry_exhausted(site: str, attempts: int,
+                           reg: Optional[MetricsRegistry] = None) -> None:
+    """A retry loop gave up; the caller degrades or aborts."""
+    reg = reg if reg is not None else _REGISTRY
+    reg.inc("res_retry_exhausted")
+    _trace.get_tracer().point("retry", f"{site}/exhausted",
+                              attempt=int(attempts), error="exhausted",
+                              injected=0)
+
+
+def record_fault(site: str, index: int, action: str,
+                 reg: Optional[MetricsRegistry] = None) -> None:
+    """Account one injected fault (racon_tpu/resilience/faults.py)."""
+    reg = reg if reg is not None else _REGISTRY
+    reg.inc("res_fault_injected_total")
+    reg.inc(f"res_fault_site_{_site_key(site)}")
+    _trace.get_tracer().point("fault", site, index=int(index),
+                              action=action)
+
+
+def record_degraded(n_windows: int,
+                    reg: Optional[MetricsRegistry] = None) -> None:
+    """A chunk exhausted its retries and its windows were re-polished
+    on the host-fallback consensus path."""
+    reg = reg if reg is not None else _REGISTRY
+    reg.inc("res_degraded_chunks")
+    reg.inc("res_degraded_windows", int(n_windows))
+
+
+def record_ckpt(event: str, tid: int, nbytes: int,
+                reg: Optional[MetricsRegistry] = None) -> None:
+    """Account one checkpoint event: ``commit`` (contig durably
+    retired), ``skip`` (resume re-emitted a committed contig), or
+    ``resume`` (store opened with N committed contigs in ``tid``)."""
+    reg = reg if reg is not None else _REGISTRY
+    reg.inc(f"res_ckpt_{event}s" if event != "resume" else
+            "res_ckpt_resumes")
+    if event == "commit":
+        reg.inc("res_ckpt_bytes", int(nbytes))
+    _trace.get_tracer().point("checkpoint", event, tid=int(tid),
+                              bytes=int(nbytes))
+
+
+def resilience_extras(reg: Optional[MetricsRegistry] = None
+                      ) -> Dict[str, object]:
+    """The registry's res_* keys as a JSON-ready dict (bench extras /
+    obs_report "Resilience" section). Empty when nothing resilience-
+    related happened, so quiet runs stay quiet."""
+    reg = reg if reg is not None else _REGISTRY
+    out: Dict[str, object] = {}
+    for k, v in sorted(reg.snapshot().items()):
+        if k.startswith("res_"):
+            out[k] = round(v, 4) if isinstance(v, float) else v
+    return out
+
+
 # ------------------------------------------------------ pipeline gauges
 
 def record_stage(name: str, busy_s: float, stall_in_s: float,

@@ -143,7 +143,11 @@ class Tracer:
         self._next_id = 0
         self._xprof = os.environ.get(ENV_XPROF, "") not in ("", "0",
                                                             "false")
-        self._fh = open(path, "w", encoding="utf-8")
+        # Spans stream to a ``.part`` sidecar; finish() promotes it to
+        # ``path`` atomically, so readers of ``path`` never observe a
+        # half-written trace (a killed run leaves only the sidecar).
+        self._part = path + ".part"
+        self._fh = open(self._part, "w", encoding="utf-8")
         self._write({"ev": "begin", "schema": SCHEMA_VERSION,
                      "unix_time": time.time()})
 
@@ -211,13 +215,17 @@ class Tracer:
         self.emit(kind, name, time.perf_counter() - dur_s, dur_s, **attrs)
 
     def finish(self, metrics: Optional[dict] = None) -> None:
-        """Write a final metrics snapshot and close the file."""
+        """Write a final metrics snapshot, then atomically promote the
+        ``.part`` sidecar to the configured path."""
         if metrics:
             self._write({"ev": "metrics", **metrics})
         with self._lock:
-            if self._fh is not None:
-                self._fh.close()
-                self._fh = None
+            if self._fh is None:
+                return
+            self._fh.close()
+            self._fh = None
+        from racon_tpu.utils.atomicio import atomic_finalize
+        atomic_finalize(self._part, self.path)
 
 
 _tracer: Optional[object] = None

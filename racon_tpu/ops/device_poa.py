@@ -689,20 +689,28 @@ def put_chunk_bufs(plan: ChunkPlan, mesh=None) -> Tuple[object, object]:
     import time
     import jax
     from racon_tpu.obs.metrics import record_h2d
+    from racon_tpu.resilience.retry import call as retry_call
 
     job_h, win_h = plan.packed_bufs()
-    t0 = time.perf_counter()
-    if mesh is None:
-        job_buf, win_buf = jax.device_put((job_h, win_h))
-    else:
-        from jax.sharding import NamedSharding, PartitionSpec
-        job_buf = jax.device_put(
-            job_h, NamedSharding(mesh, PartitionSpec("dp")))
-        win_buf = jax.device_put(
-            win_h, NamedSharding(mesh, PartitionSpec()))
-    record_h2d(job_h.nbytes + win_h.nbytes, time.perf_counter() - t0,
-               name="h2d/chunk")
-    return job_buf, win_buf
+
+    def _put():
+        t0 = time.perf_counter()
+        if mesh is None:
+            job_buf, win_buf = jax.device_put((job_h, win_h))
+        else:
+            from jax.sharding import NamedSharding, PartitionSpec
+            job_buf = jax.device_put(
+                job_h, NamedSharding(mesh, PartitionSpec("dp")))
+            win_buf = jax.device_put(
+                win_h, NamedSharding(mesh, PartitionSpec()))
+        record_h2d(job_h.nbytes + win_h.nbytes,
+                   time.perf_counter() - t0, name="h2d/chunk")
+        return job_buf, win_buf
+
+    # The transfer retries whole: device_put is idempotent from the
+    # host buffers, and a RetryExhausted here is the degradation signal
+    # the engine catches to route the chunk to the host path.
+    return retry_call("h2d/chunk", _put)
 
 
 def dispatch_chunk(plan: ChunkPlan, *, match: int, mismatch: int,
@@ -765,8 +773,9 @@ def dispatch_chunk(plan: ChunkPlan, *, match: int, mismatch: int,
             # in-flight job_buf would otherwise bleed into "compute".
             t0 = sync(job_buf, "h2d/job", t0)
             t0 = sync(win_buf, "h2d", t0)
-        packed = device_chunk_packed(
-            job_buf, win_buf,
+        from racon_tpu.resilience.retry import call as retry_call
+        packed = retry_call(
+            "dispatch/chunk", device_chunk_packed, job_buf, win_buf,
             match=match, mismatch=mismatch, gap=gap, ins_scale=ins_scale,
             Lq=plan.Lq, n_win=plan.n_win, LA=plan.LA,
             pallas=pallas, band_w=band_w, rounds=rounds, mesh=mesh)
@@ -831,13 +840,19 @@ def collect_chunk(plan: ChunkPlan, packed, stats: Optional[dict] = None
     """
     import time
     from racon_tpu.obs.metrics import record_d2h
+    from racon_tpu.resilience.retry import call as retry_call
 
-    t0 = time.perf_counter()
-    ph = np.asarray(packed)
-    # The pull blocks until the chunk's compute drains too, so this is
-    # "time blocked in d2h", an upper bound on pure transfer (metrics
-    # module docstring discusses the bandwidth-estimate semantics).
-    record_d2h(ph.nbytes, time.perf_counter() - t0, name="d2h/chunk")
+    def _pull():
+        t0 = time.perf_counter()
+        ph = np.asarray(packed)
+        # The pull blocks until the chunk's compute drains too, so this
+        # is "time blocked in d2h", an upper bound on pure transfer
+        # (metrics module docstring discusses the bandwidth-estimate
+        # semantics).
+        record_d2h(ph.nbytes, time.perf_counter() - t0, name="d2h/chunk")
+        return ph
+
+    ph = retry_call("d2h/chunk", _pull)
     if stats is not None and "_t_pack" in stats:
         stats["d2h"] = stats.get("d2h", 0.0) + \
             (time.perf_counter() - stats.pop("_t_pack"))

@@ -322,6 +322,20 @@ class PoaEngine:
                   "the host path", file=self.log)
             self._consensus_host(trunc, force_native=True)
 
+    def _degrade(self, ws: List[Window], exc) -> None:
+        """Last-resort graceful degradation: a transfer/dispatch choke
+        point exhausted its retry budget (resilience/retry.py), so this
+        chunk's windows polish on the host path instead of aborting the
+        run. The host and device paths are bit-identical by design, so
+        degraded output stays correct — only slower."""
+        from racon_tpu.obs.metrics import record_degraded
+        print(f"[racon_tpu::PoaEngine] device path gave up at "
+              f"{getattr(exc, 'site', '?')} after retries ({exc}); "
+              f"polishing {len(ws)} window(s) on the host path",
+              file=self.log)
+        record_degraded(len(ws))
+        self._consensus_host(ws, force_native=True)
+
     def _make_scheduler(self):
         """ConvergenceScheduler wired to this engine's (shared, run-
         accumulating) telemetry — one construction for the serial sched
@@ -359,6 +373,7 @@ class PoaEngine:
             self._apply_group(ws, codes, covs, trunc)
 
         from racon_tpu.obs.trace import get_tracer
+        from racon_tpu.resilience.retry import RetryExhausted
         from racon_tpu.sched import sched_enabled
         tracer = get_tracer()
         if sched_enabled():
@@ -368,17 +383,32 @@ class PoaEngine:
             # so overlap comes from prefetching the NEXT chunk's h2d
             # (async device_put) before running the current rounds.
             sched = self._make_scheduler()
-            plan = make_plan(groups[0]) if groups else None
-            bufs = sched.put_chunk(plan) if plan is not None else None
+
+            def prefetch(ws: List[Window]):
+                plan = make_plan(ws)
+                try:
+                    return plan, sched.put_chunk(plan)
+                except RetryExhausted as exc:
+                    self._degrade(ws, exc)
+                    return None
+
+            nxt = prefetch(groups[0]) if groups else None
             for k, ws in enumerate(groups):
-                cur_plan, cur_bufs = plan, bufs
-                if k + 1 < len(groups):
-                    plan = make_plan(groups[k + 1])
-                    bufs = sched.put_chunk(plan)
-                with tracer.span("chunk", f"chunk{k}", windows=len(ws),
-                                 lanes=cur_plan.B, jobs=cur_plan.n_jobs):
-                    codes, covs = sched.run_chunk(cur_plan, bufs=cur_bufs,
-                                                  stats=self.stats)
+                cur = nxt
+                nxt = prefetch(groups[k + 1]) \
+                    if k + 1 < len(groups) else None
+                if cur is None:
+                    continue        # degraded at prefetch
+                cur_plan, cur_bufs = cur
+                try:
+                    with tracer.span("chunk", f"chunk{k}",
+                                     windows=len(ws), lanes=cur_plan.B,
+                                     jobs=cur_plan.n_jobs):
+                        codes, covs = sched.run_chunk(
+                            cur_plan, bufs=cur_bufs, stats=self.stats)
+                except RetryExhausted as exc:
+                    self._degrade(ws, exc)
+                    continue
                 apply(ws, codes, covs)
         else:
             # Fixed-round pipeline: chunk i+1's h2d + dispatch go out
@@ -395,7 +425,12 @@ class PoaEngine:
                 # they overlap as siblings instead of nesting falsely.
                 ws, plan, packed, k, t_disp = entry
                 import time as _time
-                codes, covs = collect_chunk(plan, packed, stats=self.stats)
+                try:
+                    codes, covs = collect_chunk(plan, packed,
+                                                stats=self.stats)
+                except RetryExhausted as exc:
+                    self._degrade(ws, exc)
+                    return
                 tracer.emit("chunk", f"chunk{k}", t_disp,
                             _time.perf_counter() - t_disp,
                             windows=len(ws), lanes=plan.B,
@@ -406,12 +441,17 @@ class PoaEngine:
             for k, ws in enumerate(groups):
                 t_disp = _time.perf_counter()
                 plan = make_plan(ws)
-                packed = dispatch_chunk(
-                    plan, match=self.match, mismatch=self.mismatch,
-                    gap=self.gap,
-                    ins_scale=self._round_scales(self.refine_rounds + 1),
-                    rounds=self.refine_rounds + 1, stats=self.stats,
-                    mesh=self.mesh)
+                try:
+                    packed = dispatch_chunk(
+                        plan, match=self.match, mismatch=self.mismatch,
+                        gap=self.gap,
+                        ins_scale=self._round_scales(
+                            self.refine_rounds + 1),
+                        rounds=self.refine_rounds + 1, stats=self.stats,
+                        mesh=self.mesh)
+                except RetryExhausted as exc:
+                    self._degrade(ws, exc)
+                    continue
                 pending.append((ws, plan, packed, k, t_disp))
                 if len(pending) > depth:
                     finish(pending.pop(0))

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from racon_tpu.obs.metrics import record_d2h, record_h2d
+from racon_tpu.resilience.retry import call as retry_call
 from racon_tpu.utils.jaxcompat import pvary, shard_map
 
 
@@ -81,14 +82,18 @@ def shard_align_inputs(mesh: Mesh, q: np.ndarray, t: np.ndarray,
         lt = np.concatenate([lt, np.ones(Bp - B, lt.dtype)])
     row = NamedSharding(mesh, P(axis, None))
     vec = NamedSharding(mesh, P(axis))
-    t0 = time.perf_counter()
-    out = (jax.device_put(jnp.asarray(q), row),
-           jax.device_put(jnp.asarray(t), row),
-           jax.device_put(jnp.asarray(lq), vec),
-           jax.device_put(jnp.asarray(lt), vec), B)
-    record_h2d(q.nbytes + t.nbytes + lq.nbytes + lt.nbytes,
-               time.perf_counter() - t0, name="h2d/align")
-    return out
+
+    def _put():
+        t0 = time.perf_counter()
+        out = (jax.device_put(jnp.asarray(q), row),
+               jax.device_put(jnp.asarray(t), row),
+               jax.device_put(jnp.asarray(lq), vec),
+               jax.device_put(jnp.asarray(lt), vec), B)
+        record_h2d(q.nbytes + t.nbytes + lq.nbytes + lt.nbytes,
+                   time.perf_counter() - t0, name="h2d/align")
+        return out
+
+    return retry_call("h2d/align", _put)
 
 
 def nw_align_batch_sharded(mesh: Mesh, q: np.ndarray, t: np.ndarray,
@@ -103,10 +108,15 @@ def nw_align_batch_sharded(mesh: Mesh, q: np.ndarray, t: np.ndarray,
     with mesh:
         ops, n = nw_align_batch(qd, td, lqd, ltd, match=match,
                                 mismatch=mismatch, gap=gap)
-    t0 = time.perf_counter()
-    ops_h, n_h = np.asarray(ops), np.asarray(n)
-    record_d2h(ops_h.nbytes + n_h.nbytes, time.perf_counter() - t0,
-               name="d2h/align")
+
+    def _pull():
+        t0 = time.perf_counter()
+        ops_h, n_h = np.asarray(ops), np.asarray(n)
+        record_d2h(ops_h.nbytes + n_h.nbytes, time.perf_counter() - t0,
+                   name="d2h/align")
+        return ops_h, n_h
+
+    ops_h, n_h = retry_call("d2h/align", _pull)
     return ops_h[:B], n_h[:B]
 
 
@@ -208,10 +218,14 @@ def sp_nw_scores(mesh: Mesh, q: np.ndarray, t: np.ndarray, lq: np.ndarray,
     qd, td, lqd, ltd, B = shard_align_inputs(mesh, q, t, lq, lt)
     out = _sp_scores_jit(qd, td, lqd, ltd, match=match, mismatch=mismatch,
                          gap=gap, mesh=mesh)
-    t0 = time.perf_counter()
-    out_h = np.asarray(out)
-    record_d2h(out_h.nbytes, time.perf_counter() - t0, name="d2h/sp")
-    return out_h[:B]
+
+    def _pull():
+        t0 = time.perf_counter()
+        out_h = np.asarray(out)
+        record_d2h(out_h.nbytes, time.perf_counter() - t0, name="d2h/sp")
+        return out_h
+
+    return retry_call("d2h/sp", _pull)[:B]
 
 
 @functools.partial(jax.jit,
@@ -313,11 +327,16 @@ def sp_nw_align(mesh: Mesh, q: np.ndarray, t: np.ndarray, lq: np.ndarray,
     ops, n = _sp_align_jit(qd, td, lqd, ltd, match=match,
                            mismatch=mismatch, gap=gap, mesh=mesh)
     W = ops.shape[1]
-    t0 = time.perf_counter()
-    ops_h = np.asarray(ops)
-    n_h = np.asarray(n)
-    record_d2h(ops_h.nbytes + n_h.nbytes, time.perf_counter() - t0,
-               name="d2h/sp")
+
+    def _pull():
+        t0 = time.perf_counter()
+        ops_h = np.asarray(ops)
+        n_h = np.asarray(n)
+        record_d2h(ops_h.nbytes + n_h.nbytes, time.perf_counter() - t0,
+                   name="d2h/sp")
+        return ops_h, n_h
+
+    ops_h, n_h = retry_call("d2h/sp", _pull)
     ops_h = ops_h[:B]
     n_h = n_h[:B]
     # Re-right-align to Lq+Lt width if target padding widened the walk.

@@ -203,31 +203,52 @@ def stream_consensus(engine, windows, chunk: int = 8192,
         item.plan = engine._make_chunk_plan(item.sp, item.windows)
         return item
 
-    def h2d(item: _Item) -> _Item:
+    def degrade(item: _Item, exc) -> None:
+        # Retry budget exhausted at a transfer/dispatch choke point:
+        # the chunk's windows polish on the (bit-identical) host path
+        # and the item retires normally — degradation must never lose
+        # a slice or change emitted bytes.
+        with host_lock:
+            engine._degrade(item.windows, exc)
+        item.plan = item.bufs = None
+
+    def h2d(item: _Item) -> Optional[_Item]:
         from racon_tpu.ops.device_poa import put_chunk_bufs
+        from racon_tpu.resilience.retry import RetryExhausted
         # Async device_put: returns immediately, transfer overlaps the
         # current chunk's compute. q_run's capacity (= depth) bounds how
         # many chunks' input buffers are resident in HBM.
-        item.bufs = put_chunk_bufs(item.plan, mesh=engine.mesh)
+        try:
+            item.bufs = put_chunk_bufs(item.plan, mesh=engine.mesh)
+        except RetryExhausted as exc:
+            degrade(item, exc)
+            q_done.put(item)        # bypass compute, retire directly
+            return None
         return item
 
     def compute(item: _Item) -> _Item:
         from racon_tpu.ops.device_poa import collect_chunk, dispatch_chunk
+        from racon_tpu.resilience.retry import RetryExhausted
         trunc: List = []
-        with tracer.span("chunk", f"chunk{item.sid}.{item.gid}",
-                         windows=len(item.windows), lanes=item.plan.B,
-                         jobs=item.plan.n_jobs):
-            if sched is not None:
-                codes, covs = sched.run_chunk(item.plan, bufs=item.bufs)
-            else:
-                packed = dispatch_chunk(
-                    item.plan, match=engine.match,
-                    mismatch=engine.mismatch, gap=engine.gap,
-                    ins_scale=engine._round_scales(
-                        engine.refine_rounds + 1),
-                    rounds=engine.refine_rounds + 1, mesh=engine.mesh,
-                    bufs=item.bufs)
-                codes, covs = collect_chunk(item.plan, packed)
+        try:
+            with tracer.span("chunk", f"chunk{item.sid}.{item.gid}",
+                             windows=len(item.windows),
+                             lanes=item.plan.B, jobs=item.plan.n_jobs):
+                if sched is not None:
+                    codes, covs = sched.run_chunk(item.plan,
+                                                  bufs=item.bufs)
+                else:
+                    packed = dispatch_chunk(
+                        item.plan, match=engine.match,
+                        mismatch=engine.mismatch, gap=engine.gap,
+                        ins_scale=engine._round_scales(
+                            engine.refine_rounds + 1),
+                        rounds=engine.refine_rounds + 1,
+                        mesh=engine.mesh, bufs=item.bufs)
+                    codes, covs = collect_chunk(item.plan, packed)
+        except RetryExhausted as exc:
+            degrade(item, exc)
+            return item
         engine._apply_group(item.windows, codes, covs, trunc)
         if trunc:
             with host_lock:

@@ -1,0 +1,35 @@
+"""Resilience: transfer retry/backoff, fault injection, checkpoint/resume.
+
+Long polishing runs over a flaky accelerator link need the same three
+safety nets as any production training stack:
+
+- :mod:`racon_tpu.resilience.retry` — bounded exponential backoff
+  around every h2d/d2h/dispatch choke point, degrading a chunk to the
+  host consensus path when the budget is exhausted
+  (``RACON_TPU_RETRY``).
+- :mod:`racon_tpu.resilience.faults` — deterministic, env-gated fault
+  injector that proves those paths on CPU (``RACON_TPU_FAULTS``).
+- :mod:`racon_tpu.resilience.checkpoint` — contig-granular
+  checkpoint/resume keyed by a run fingerprint
+  (``--checkpoint-dir`` / ``--resume``).
+
+docs/RESILIENCE.md is the operator-facing reference.
+"""
+
+from racon_tpu.resilience.checkpoint import (CheckpointError,
+                                             CheckpointStore,
+                                             run_fingerprint)
+from racon_tpu.resilience.faults import (ENV_FAULTS, FaultInjector,
+                                         FaultSpecError, InjectedFault,
+                                         maybe_fault)
+from racon_tpu.resilience.retry import (ENV_RETRY, RetryExhausted,
+                                        RetryPolicy, call as with_retry,
+                                        default_policy)
+
+__all__ = [
+    "CheckpointError", "CheckpointStore", "run_fingerprint",
+    "ENV_FAULTS", "FaultInjector", "FaultSpecError", "InjectedFault",
+    "maybe_fault",
+    "ENV_RETRY", "RetryExhausted", "RetryPolicy", "with_retry",
+    "default_policy",
+]

@@ -1,0 +1,306 @@
+"""Contig-granular checkpoint/resume for preemption-safe polishing.
+
+A polishing run's unit of durable progress is the **contig**: the
+polisher retires targets in input order (serial loop and SliceTracker
+pipeline alike), so "contigs 0..k committed" fully describes a partial
+run. The store keeps three files in ``--checkpoint-dir``:
+
+``meta.json``
+    ``{"schema": 1, "fingerprint": "<hex>"}`` — written atomically
+    (utils/atomicio) when the store is created. The fingerprint hashes
+    every output-affecting CLI setting plus the sha256 of each input
+    file, so ``--resume`` refuses to splice contigs from a different
+    run configuration into this one.
+
+``contigs.fasta``
+    The shard: each committed contig's exact emitted bytes
+    (``>name\\ndata\\n``) appended and fsync'd. Re-emission on resume
+    slices this file, so resumed stdout is byte-identical by
+    construction, not by re-serialization.
+
+``manifest.jsonl``
+    A begin header ``{"ev": "begin", "schema": 1, "fingerprint": ...}``
+    then one record per committed target:
+    ``{"ev": "contig", "tid": N, "name": ..., "offset": O, "length": L}``
+    or ``{"ev": "contig", "tid": N, "emitted": false}`` for targets the
+    run dropped (--drop-unpolished semantics must survive resume too).
+
+Crash consistency is ordering, not locking: the shard append is fsync'd
+**before** its manifest record is appended (also fsync'd), so a
+manifest record always points at durable shard bytes. On resume the
+store takes the longest valid manifest prefix (a torn tail line is
+dropped and the manifest rewritten atomically), then truncates the
+shard to the last referenced byte — orphaned shard bytes from a crash
+between the two appends are discarded and that contig recomputes.
+
+Commits pass through the ``ckpt/commit`` fault site, so the
+kill-mid-commit scenario (scripts/resilience_smoke.py) is reproducible.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Dict, IO, Iterable, Optional
+
+from racon_tpu.utils.atomicio import (append_fsync, atomic_write_text,
+                                      fsync_dir)
+
+SCHEMA = 1
+META_NAME = "meta.json"
+SHARD_NAME = "contigs.fasta"
+MANIFEST_NAME = "manifest.jsonl"
+
+
+class CheckpointError(ValueError):
+    """Unusable checkpoint directory: fingerprint mismatch, missing or
+    corrupt metadata. Deliberately a hard error — silently recomputing
+    would mask operator mistakes (wrong dir, changed inputs)."""
+
+
+def file_digest(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as fh:
+        for block in iter(lambda: fh.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+def run_fingerprint(config: Dict, paths: Iterable[str]) -> str:
+    """Hash of the output-affecting run identity.
+
+    ``config`` holds every CLI setting that changes emitted bytes
+    (scores, window length, rounds, quality/trimming flags...);
+    ``paths`` are the input files, digested by content so a re-sorted
+    or edited FASTQ invalidates old checkpoints even under the same
+    filename.
+    """
+    ident = {
+        "schema": SCHEMA,
+        "config": config,
+        "inputs": [{"path": os.path.basename(p),
+                    "sha256": file_digest(p)} for p in paths],
+    }
+    blob = json.dumps(ident, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+class CheckpointStore:
+    """Append-only contig store bound to one run fingerprint.
+
+    Use :meth:`create` for a fresh run (``--checkpoint-dir``) and
+    :meth:`resume` to continue one (``--resume``). ``committed`` maps
+    target index → manifest record for everything durably stored.
+    """
+
+    def __init__(self, directory: str, fingerprint: str):
+        self.directory = directory
+        self.fingerprint = fingerprint
+        self.committed: Dict[int, Dict] = {}
+        self._shard: Optional[IO[bytes]] = None
+        self._manifest: Optional[IO[bytes]] = None
+
+    # -------------------------------------------------- construction
+    @property
+    def meta_path(self) -> str:
+        return os.path.join(self.directory, META_NAME)
+
+    @property
+    def shard_path(self) -> str:
+        return os.path.join(self.directory, SHARD_NAME)
+
+    @property
+    def manifest_path(self) -> str:
+        return os.path.join(self.directory, MANIFEST_NAME)
+
+    @classmethod
+    def create(cls, directory: str,
+               fingerprint: str) -> "CheckpointStore":
+        """Start a fresh store, replacing any previous contents."""
+        os.makedirs(directory, exist_ok=True)
+        store = cls(directory, fingerprint)
+        for path in (store.shard_path, store.manifest_path):
+            if os.path.exists(path):
+                os.remove(path)
+        atomic_write_text(store.meta_path, json.dumps(
+            {"schema": SCHEMA, "fingerprint": fingerprint},
+            sort_keys=True) + "\n")
+        store._shard = open(store.shard_path, "ab")
+        store._manifest = open(store.manifest_path, "ab")
+        header = {"ev": "begin", "schema": SCHEMA,
+                  "fingerprint": fingerprint}
+        append_fsync(store._manifest, (json.dumps(
+            header, sort_keys=True) + "\n").encode())
+        return store
+
+    @classmethod
+    def resume(cls, directory: str,
+               fingerprint: str) -> "CheckpointStore":
+        """Open an existing store, refusing on any identity mismatch."""
+        store = cls(directory, fingerprint)
+        try:
+            with open(store.meta_path, "r", encoding="utf-8") as fh:
+                meta = json.load(fh)
+        except (OSError, ValueError) as exc:
+            raise CheckpointError(
+                f"[racon_tpu::checkpoint] cannot resume from "
+                f"{directory!r}: unreadable {META_NAME} ({exc})") from exc
+        if meta.get("schema") != SCHEMA:
+            raise CheckpointError(
+                f"[racon_tpu::checkpoint] {directory!r} has schema "
+                f"{meta.get('schema')!r}, this build writes {SCHEMA}")
+        if meta.get("fingerprint") != fingerprint:
+            raise CheckpointError(
+                f"[racon_tpu::checkpoint] refusing to resume: "
+                f"checkpoint fingerprint {meta.get('fingerprint')!r} "
+                f"does not match this run ({fingerprint!r}) — inputs "
+                "or output-affecting options changed")
+        store._recover()
+        return store
+
+    def _recover(self) -> None:
+        """Longest-valid-prefix manifest recovery + shard truncation."""
+        records = []
+        torn = False
+        try:
+            with open(self.manifest_path, "rb") as fh:
+                raw = fh.read()
+        except OSError as exc:
+            raise CheckpointError(
+                f"[racon_tpu::checkpoint] cannot resume: unreadable "
+                f"{MANIFEST_NAME} ({exc})") from exc
+        lines = raw.split(b"\n")
+        # A well-formed file ends with a newline → last split is empty;
+        # anything after the final newline is a torn tail by definition.
+        if lines and lines[-1] != b"":
+            torn = True
+        for line in lines[:-1] if lines else []:
+            try:
+                rec = json.loads(line)
+                if rec.get("ev") == "contig":
+                    if "offset" in rec:
+                        _ = (int(rec["tid"]), int(rec["offset"]),
+                             int(rec["length"]), rec["name"])
+                    else:
+                        _ = (int(rec["tid"]), rec["emitted"])
+            except (ValueError, KeyError, TypeError):
+                torn = True
+                break
+            records.append(rec)
+        if not records or records[0].get("ev") != "begin":
+            raise CheckpointError(
+                f"[racon_tpu::checkpoint] cannot resume: "
+                f"{MANIFEST_NAME} missing begin header")
+        if records[0].get("fingerprint") != self.fingerprint:
+            raise CheckpointError(
+                "[racon_tpu::checkpoint] refusing to resume: manifest "
+                "header fingerprint does not match this run")
+
+        shard_size = os.path.getsize(self.shard_path) \
+            if os.path.exists(self.shard_path) else 0
+        shard_end = 0
+        valid = [records[0]]
+        for rec in records[1:]:
+            if rec.get("ev") != "contig":
+                continue
+            if "offset" in rec:
+                end = int(rec["offset"]) + int(rec["length"])
+                if end > shard_size:
+                    # Manifest record without its shard bytes: only
+                    # possible with external tampering (the write order
+                    # forbids it) — stop trusting from here on.
+                    break
+                shard_end = max(shard_end, end)
+            valid.append(rec)
+
+        if torn or len(valid) != len(records):
+            data = b"".join(json.dumps(r, sort_keys=True).encode()
+                            + b"\n" for r in valid)
+            from racon_tpu.utils.atomicio import atomic_write_bytes
+            atomic_write_bytes(self.manifest_path, data)
+        if shard_size > shard_end:
+            # Orphaned tail from a crash between shard append and
+            # manifest append: discard, that contig recomputes.
+            with open(self.shard_path, "r+b") as fh:
+                fh.truncate(shard_end)
+                fh.flush()
+                os.fsync(fh.fileno())
+            fsync_dir(self.directory)
+
+        for rec in valid[1:]:
+            self.committed[int(rec["tid"])] = rec
+
+        from racon_tpu.obs.metrics import record_ckpt
+        record_ckpt("resume", len(self.committed), shard_end)
+
+        self._shard = open(self.shard_path, "ab")
+        self._manifest = open(self.manifest_path, "ab")
+
+    # ---------------------------------------------------- operations
+    def commit(self, tid: int, name: bytes, data: bytes) -> None:
+        """Durably store target ``tid``'s emitted FASTA record.
+
+        Write order is the crash-consistency contract: shard bytes
+        reach disk before the manifest record that references them.
+        """
+        if self._shard is None or self._manifest is None:
+            raise CheckpointError(
+                "[racon_tpu::checkpoint] commit on a closed store")
+        from racon_tpu.obs.metrics import record_ckpt
+        from racon_tpu.resilience.faults import maybe_fault
+        maybe_fault("ckpt/commit")
+        blob = b">" + name + b"\n" + data + b"\n"
+        off = append_fsync(self._shard, blob)
+        rec = {"ev": "contig", "tid": int(tid),
+               "name": name.decode("utf-8", "replace"),
+               "offset": off, "length": len(blob)}
+        append_fsync(self._manifest, (json.dumps(
+            rec, sort_keys=True) + "\n").encode())
+        self.committed[int(tid)] = rec
+        record_ckpt("commit", tid, len(blob))
+
+    def commit_dropped(self, tid: int) -> None:
+        """Record that ``tid`` completed but emits nothing (a dropped
+        unpolished target) — resume must skip its compute too."""
+        if self._manifest is None:
+            raise CheckpointError(
+                "[racon_tpu::checkpoint] commit on a closed store")
+        from racon_tpu.obs.metrics import record_ckpt
+        from racon_tpu.resilience.faults import maybe_fault
+        maybe_fault("ckpt/commit")
+        rec = {"ev": "contig", "tid": int(tid), "emitted": False}
+        append_fsync(self._manifest, (json.dumps(
+            rec, sort_keys=True) + "\n").encode())
+        self.committed[int(tid)] = rec
+        record_ckpt("commit", tid, 0)
+
+    def read_emitted(self, tid: int) -> Optional[bytes]:
+        """The exact bytes originally emitted for ``tid`` (None for a
+        dropped target) — sliced from the shard, not re-serialized."""
+        rec = self.committed[int(tid)]
+        if "offset" not in rec:
+            return None
+        with open(self.shard_path, "rb") as fh:
+            fh.seek(int(rec["offset"]))
+            blob = fh.read(int(rec["length"]))
+        if len(blob) != int(rec["length"]):
+            raise CheckpointError(
+                f"[racon_tpu::checkpoint] shard truncated under "
+                f"manifest record for target {tid}")
+        return blob
+
+    def close(self) -> None:
+        for fh in (self._shard, self._manifest):
+            if fh is not None:
+                try:
+                    fh.close()
+                except OSError:
+                    pass
+        self._shard = self._manifest = None
+
+    def __enter__(self) -> "CheckpointStore":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

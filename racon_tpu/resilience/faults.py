@@ -1,0 +1,212 @@
+"""Deterministic, env-gated fault injection for the transfer paths.
+
+The retry/degradation/abort machinery in this package is only credible
+if it can be exercised on CPU in tier-1, where real TPU transfer faults
+never happen. ``RACON_TPU_FAULTS=<spec>`` arms :func:`maybe_fault`
+hooks that retry.call() places inside every retried attempt, raising
+synthetic :class:`InjectedFault` errors (or killing the process) at
+chosen per-site call indices.
+
+Spec grammar (clauses joined by ``;``)::
+
+    spec    := clause (';' clause)*
+    clause  := site ':' selector ['!' action]
+             | 'seed=' int
+    selector:= index (',' index)*          # explicit call indices
+             | 'p=' float                  # per-call probability
+    action  := 'raise'                     # default: InjectedFault
+             | 'kill'                      # os._exit(137), no cleanup
+             | 'term' | 'int'             # signal self (SIGTERM/SIGINT)
+
+Examples::
+
+    RACON_TPU_FAULTS='h2d/chunk:0,1,2'        # first 3 chunk uploads fail
+    RACON_TPU_FAULTS='d2h/chunk:p=0.05;seed=7'  # 5% of pulls, seeded
+    RACON_TPU_FAULTS='ckpt/commit:1!kill'     # die during 2nd commit
+
+Site names match the transfer labels in obs (``h2d/chunk``,
+``d2h/chunk``, ``h2d/align``, ``d2h/align``, ``d2h/sp``,
+``h2d/repack``, ``sched/flags``) plus ``dispatch/chunk`` and
+``ckpt/commit``. Call indices are 0-based and advance once per
+*attempt* at that site (each retry re-consults the injector), so
+``site:0,1`` verifies genuine two-failure recovery.
+
+Determinism: explicit-index decisions are pure functions of the per-site
+call counter; probability decisions hash ``(seed, site, index)`` — the
+wall clock and thread interleaving never influence whether a given call
+faults, only which thread observes it. Counters are process-wide and
+thread-safe. Every fired fault is recorded (``res_fault_*`` metrics and
+a ``fault`` trace span) via obs/metrics.py::record_fault.
+
+When the env var is unset the hook is a single None check.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import signal
+import threading
+from typing import Dict, List, Optional, Tuple
+
+ENV_FAULTS = "RACON_TPU_FAULTS"
+
+_ACTIONS = ("raise", "kill", "term", "int")
+
+
+class InjectedFault(RuntimeError):
+    """A synthetic transfer/dispatch failure raised by the injector.
+
+    ``injected`` marks the error so retry accounting can distinguish
+    synthetic from organic failures.
+    """
+
+    injected = True
+
+    def __init__(self, site: str, index: int):
+        super().__init__(
+            f"[racon_tpu::faults] injected fault at {site} call {index}")
+        self.site = site
+        self.index = index
+
+
+class FaultSpecError(ValueError):
+    pass
+
+
+class _SiteRule:
+    __slots__ = ("indices", "prob", "action")
+
+    def __init__(self, indices: Optional[frozenset], prob: float,
+                 action: str):
+        self.indices = indices   # frozenset of call indices, or None
+        self.prob = prob         # used when indices is None
+        self.action = action
+
+
+def _parse(spec: str) -> Tuple[Dict[str, _SiteRule], int]:
+    rules: Dict[str, _SiteRule] = {}
+    seed = 0
+    for clause in filter(None, (c.strip() for c in spec.split(";"))):
+        if clause.startswith("seed="):
+            try:
+                seed = int(clause[5:])
+            except ValueError:
+                raise FaultSpecError(
+                    f"[racon_tpu::faults] bad seed clause {clause!r}")
+            continue
+        if ":" not in clause:
+            raise FaultSpecError(
+                f"[racon_tpu::faults] clause {clause!r} is not "
+                "'site:selector' or 'seed=N'")
+        site, sel = clause.split(":", 1)
+        action = "raise"
+        if "!" in sel:
+            sel, action = sel.split("!", 1)
+            if action not in _ACTIONS:
+                raise FaultSpecError(
+                    f"[racon_tpu::faults] unknown action {action!r} "
+                    f"(expected one of {', '.join(_ACTIONS)})")
+        site = site.strip()
+        if not site:
+            raise FaultSpecError(
+                f"[racon_tpu::faults] empty site in clause {clause!r}")
+        try:
+            if sel.startswith("p="):
+                prob = float(sel[2:])
+                if not 0.0 <= prob <= 1.0:
+                    raise ValueError
+                rules[site] = _SiteRule(None, prob, action)
+            else:
+                idx = frozenset(int(p) for p in sel.split(","))
+                if any(i < 0 for i in idx):
+                    raise ValueError
+                rules[site] = _SiteRule(idx, 0.0, action)
+        except ValueError:
+            raise FaultSpecError(
+                f"[racon_tpu::faults] bad selector {sel!r} in clause "
+                f"{clause!r}")
+    return rules, seed
+
+
+class FaultInjector:
+    """Parsed fault plan + per-site call counters."""
+
+    def __init__(self, spec: str, seed: Optional[int] = None):
+        self._rules, parsed_seed = _parse(spec)
+        self.seed = parsed_seed if seed is None else int(seed)
+        self.spec = spec
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {}
+        self.fired: List[Tuple[str, int, str]] = []
+
+    def sites(self) -> Tuple[str, ...]:
+        return tuple(sorted(self._rules))
+
+    def _decide(self, site: str, index: int) -> Optional[str]:
+        rule = self._rules.get(site)
+        if rule is None:
+            return None
+        if rule.indices is not None:
+            return rule.action if index in rule.indices else None
+        h = hashlib.sha256(
+            f"{self.seed}:{site}:{index}".encode()).digest()
+        u = int.from_bytes(h[:8], "big") / 2 ** 64
+        return rule.action if u < rule.prob else None
+
+    def check(self, site: str) -> None:
+        """Advance ``site``'s call counter; fire if the plan says so."""
+        with self._lock:
+            index = self._counts.get(site, 0)
+            self._counts[site] = index + 1
+            action = self._decide(site, index)
+            if action is not None:
+                self.fired.append((site, index, action))
+        if action is None:
+            return
+        from racon_tpu.obs.metrics import record_fault
+        record_fault(site, index, action)
+        if action == "raise":
+            raise InjectedFault(site, index)
+        if action == "kill":
+            # Simulated hard crash: no atexit, no flushes — exactly the
+            # scenario the checkpoint store's fsync ordering protects.
+            os._exit(137)
+        os.kill(os.getpid(), signal.SIGTERM if action == "term"
+                else signal.SIGINT)
+
+    def counts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+
+_INJECTOR: Optional[FaultInjector] = None
+_ARMED = False
+
+
+def configure(spec: Optional[str], seed: Optional[int] = None) -> \
+        Optional[FaultInjector]:
+    """Install a fault plan programmatically (tests), or clear it with
+    ``spec=None``. Returns the installed injector."""
+    global _INJECTOR, _ARMED
+    _INJECTOR = FaultInjector(spec, seed) if spec else None
+    _ARMED = True
+    return _INJECTOR
+
+
+def get_injector() -> Optional[FaultInjector]:
+    """The active injector, arming lazily from ``RACON_TPU_FAULTS``."""
+    global _INJECTOR, _ARMED
+    if not _ARMED:
+        spec = os.environ.get(ENV_FAULTS, "")
+        _INJECTOR = FaultInjector(spec) if spec else None
+        _ARMED = True
+    return _INJECTOR
+
+
+def maybe_fault(site: str) -> None:
+    """The hook retry.call() runs before every attempt. Near-free when
+    no fault plan is configured."""
+    inj = get_injector()
+    if inj is not None:
+        inj.check(site)

@@ -1,0 +1,195 @@
+"""Bounded retry with exponential backoff around transfer choke points.
+
+PROFILE.md measures this environment's axon tunnel at 1.4-7 MB/s
+"depending on the hour"; a genome-scale run multiplies that flaky link
+across thousands of chunk transfers. Every h2d/d2h/dispatch choke point
+(parallel/dispatch.py, ops/device_poa.py, sched/scheduler.py) now runs
+through :func:`call`, which
+
+- re-attempts **transient** failures (injected faults, XLA runtime
+  errors, OS/connection errors) up to ``RetryPolicy.attempts`` total
+  tries with exponential backoff + deterministic jitter,
+- propagates everything else (ValueError, programming bugs) on the
+  first occurrence — a retry loop must never mask a logic error,
+- raises :class:`RetryExhausted` when the budget runs out, which the
+  engine catches to route the chunk's windows onto the host-fallback
+  consensus path (graceful degradation — see PoaEngine._degrade and the
+  streaming pipeline's h2d/compute stages).
+
+The backoff schedule is a pure function of (policy, site, attempt): the
+jitter derives from a seeded hash, not the wall clock, so schedules are
+reproducible (tested in tests/test_resilience.py) and two processes
+retrying the same site do not thundering-herd in phase.
+
+Every retried attempt increments ``res_retry_total`` /
+``res_retry_site_*`` and emits a ``retry`` trace span
+(obs/metrics.py::record_retry); exhaustion increments
+``res_retry_exhausted``. docs/RESILIENCE.md documents the knobs.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import time
+from typing import Callable, Optional, Tuple
+
+ENV_RETRY = "RACON_TPU_RETRY"
+
+
+class RetryExhausted(RuntimeError):
+    """A retry-wrapped call site failed ``attempts`` times in a row.
+
+    ``__cause__`` chains the last underlying error; ``site`` names the
+    choke point. The consensus engine treats this as the signal to
+    degrade the affected chunk to the host path rather than abort the
+    run.
+    """
+
+    def __init__(self, site: str, attempts: int, last: BaseException):
+        super().__init__(
+            f"[racon_tpu::resilience] {site} failed after {attempts} "
+            f"attempt(s): {last!r}")
+        self.site = site
+        self.attempts = attempts
+
+
+def _transient_classes() -> Tuple[type, ...]:
+    """Exception classes worth retrying. XlaRuntimeError covers device /
+    runtime / transfer failures surfacing through jax; OSError covers
+    the tunnel's socket layer; InjectedFault is the test harness."""
+    from racon_tpu.resilience.faults import InjectedFault
+    classes = [InjectedFault, ConnectionError, TimeoutError, OSError]
+    try:  # jaxlib is present wherever the device paths run
+        from jax.errors import JaxRuntimeError
+        classes.append(JaxRuntimeError)
+    except Exception:
+        try:
+            from jaxlib.xla_extension import XlaRuntimeError
+            classes.append(XlaRuntimeError)
+        except Exception:
+            pass
+    return tuple(classes)
+
+
+_TRANSIENT: Optional[Tuple[type, ...]] = None
+
+
+def is_transient(exc: BaseException) -> bool:
+    global _TRANSIENT
+    if _TRANSIENT is None:
+        _TRANSIENT = _transient_classes()
+    return isinstance(exc, _TRANSIENT)
+
+
+class RetryPolicy:
+    """Bounded exponential backoff with deterministic jitter.
+
+    ``attempts`` is the TOTAL try budget (attempts=1 means no retries).
+    The delay before retry ``k`` (k = 1 for the first retry) is::
+
+        min(base * multiplier**(k-1), max_delay) * (1 + jitter * u)
+
+    where ``u`` in [-1, 1) derives from sha256(seed, site, k) — pure,
+    so schedules are reproducible and testable.
+    """
+
+    __slots__ = ("attempts", "base", "multiplier", "max_delay", "jitter",
+                 "seed")
+
+    def __init__(self, attempts: int = 4, base: float = 0.05,
+                 multiplier: float = 2.0, max_delay: float = 2.0,
+                 jitter: float = 0.1, seed: int = 0):
+        if attempts < 1:
+            raise ValueError(
+                f"[racon_tpu::resilience] invalid attempts {attempts}")
+        self.attempts = int(attempts)
+        self.base = float(base)
+        self.multiplier = float(multiplier)
+        self.max_delay = float(max_delay)
+        self.jitter = float(jitter)
+        self.seed = int(seed)
+
+    def retryable(self, exc: BaseException) -> bool:
+        return is_transient(exc)
+
+    def delay(self, attempt: int, site: str = "") -> float:
+        """Backoff before retry ``attempt`` (1-based), in seconds."""
+        d = min(self.base * self.multiplier ** (attempt - 1),
+                self.max_delay)
+        if self.jitter:
+            h = hashlib.sha256(
+                f"{self.seed}:{site}:{attempt}".encode()).digest()
+            u = int.from_bytes(h[:8], "big") / 2 ** 63 - 1.0  # [-1, 1)
+            d *= 1.0 + self.jitter * u
+        return max(d, 0.0)
+
+    def schedule(self, site: str = "") -> Tuple[float, ...]:
+        """The full deterministic delay sequence (attempts-1 entries)."""
+        return tuple(self.delay(k, site)
+                     for k in range(1, self.attempts))
+
+
+_DEFAULT: Optional[RetryPolicy] = None
+
+
+def default_policy() -> RetryPolicy:
+    """Process default, configurable via ``RACON_TPU_RETRY`` as a comma
+    list of key=value pairs (attempts/base/multiplier/max_delay/jitter/
+    seed), e.g. ``RACON_TPU_RETRY=attempts=6,base=0.2``. ``attempts=1``
+    disables retrying while keeping the degradation path."""
+    global _DEFAULT
+    if _DEFAULT is None:
+        kw = {}
+        spec = os.environ.get(ENV_RETRY, "")
+        for part in filter(None, (p.strip() for p in spec.split(","))):
+            try:
+                key, val = part.split("=", 1)
+                kw[key] = int(val) if key in ("attempts", "seed") \
+                    else float(val)
+            except ValueError as exc:
+                raise ValueError(
+                    f"[racon_tpu::resilience] invalid {ENV_RETRY} "
+                    f"clause {part!r}") from exc
+        _DEFAULT = RetryPolicy(**kw)
+    return _DEFAULT
+
+
+def configure(policy: Optional[RetryPolicy]) -> None:
+    """Install (or with None, drop back to env-derived) the process
+    default policy — test hook."""
+    global _DEFAULT
+    _DEFAULT = policy
+
+
+def call(site: str, fn: Callable, *args,
+         policy: Optional[RetryPolicy] = None, **kwargs):
+    """Run ``fn(*args, **kwargs)`` under the retry policy, with the
+    fault injector's hook for ``site`` armed before every try.
+
+    The injection point sits INSIDE the retried body, so a fault spec
+    like ``h2d/chunk:0,1`` exercises the real recovery path: try 1 and
+    2 raise, try 3 (call index 2 at that site) succeeds.
+    """
+    from racon_tpu.obs.metrics import (record_retry,
+                                       record_retry_exhausted)
+    from racon_tpu.resilience.faults import maybe_fault
+
+    pol = policy if policy is not None else default_policy()
+    attempt = 0
+    while True:
+        try:
+            maybe_fault(site)
+            return fn(*args, **kwargs)
+        except BaseException as exc:  # noqa: BLE001 — filtered below
+            if not pol.retryable(exc):
+                raise
+            attempt += 1
+            if attempt >= pol.attempts:
+                record_retry_exhausted(site, attempt)
+                raise RetryExhausted(site, attempt, exc) from exc
+            d = pol.delay(attempt, site)
+            record_retry(site, attempt, d, type(exc).__name__,
+                         getattr(exc, "injected", False))
+            if d > 0:
+                time.sleep(d)

@@ -166,11 +166,17 @@ class ConvergenceScheduler:
             # (they feed telemetry for free). This pull is the sync
             # point, so its time (compute wait + tunnel round-trip) is
             # accounted separately from the transfer bandwidth keys.
-            t_pull = time.perf_counter()
-            conv_h = np.asarray(conv)
-            ovf_h = np.asarray(ovf)
-            record_flag_pull(conv_h.nbytes + ovf_h.nbytes,
-                             time.perf_counter() - t_pull)
+            from racon_tpu.resilience.retry import call as retry_call
+
+            def _pull_flags():
+                t_pull = time.perf_counter()
+                conv_h = np.asarray(conv)
+                ovf_h = np.asarray(ovf)
+                record_flag_pull(conv_h.nbytes + ovf_h.nbytes,
+                                 time.perf_counter() - t_pull)
+                return conv_h, ovf_h
+
+            conv_h, ovf_h = retry_call("sched/flags", _pull_flags)
             frozen = real & (conv_h | ovf_h)
             telem.record_freeze(executed, int(frozen.sum()))
             surv = real & ~conv_h & ~ovf_h
@@ -214,22 +220,28 @@ class ConvergenceScheduler:
             t0 = time.perf_counter()
             rp = RepackPlan(surv, cur_win_h, cur_orig, trash=trash,
                             n_shards=ndp)
-            t_put = time.perf_counter()
-            if self.mesh is None:
-                lane_idx_d, new_win_d, win_map_d, win_real_d = \
-                    jax.device_put((rp.lane_idx, rp.new_win, rp.win_map,
-                                    rp.win_real))
-            else:
-                from jax.sharding import NamedSharding, PartitionSpec as P
-                rep = NamedSharding(self.mesh, P())
-                lane_idx_d = jax.device_put(rp.lane_idx, rep)
-                win_map_d = jax.device_put(rp.win_map, rep)
-                win_real_d = jax.device_put(rp.win_real, rep)
-                new_win_d = jax.device_put(
-                    rp.new_win, NamedSharding(self.mesh, P("dp")))
-            record_h2d(rp.lane_idx.nbytes + rp.new_win.nbytes +
-                       rp.win_map.nbytes + rp.win_real.nbytes,
-                       time.perf_counter() - t_put, name="h2d/repack")
+            def _put_repack():
+                t_put = time.perf_counter()
+                if self.mesh is None:
+                    lane_idx_d, new_win_d, win_map_d, win_real_d = \
+                        jax.device_put((rp.lane_idx, rp.new_win,
+                                        rp.win_map, rp.win_real))
+                else:
+                    from jax.sharding import (NamedSharding,
+                                              PartitionSpec as P)
+                    rep = NamedSharding(self.mesh, P())
+                    lane_idx_d = jax.device_put(rp.lane_idx, rep)
+                    win_map_d = jax.device_put(rp.win_map, rep)
+                    win_real_d = jax.device_put(rp.win_real, rep)
+                    new_win_d = jax.device_put(
+                        rp.new_win, NamedSharding(self.mesh, P("dp")))
+                record_h2d(rp.lane_idx.nbytes + rp.new_win.nbytes +
+                           rp.win_map.nbytes + rp.win_real.nbytes,
+                           time.perf_counter() - t_put, name="h2d/repack")
+                return lane_idx_d, new_win_d, win_map_d, win_real_d
+
+            lane_idx_d, new_win_d, win_map_d, win_real_d = \
+                retry_call("h2d/repack", _put_repack)
             with tracer.span("dispatch", "repack", lanes=rp.B,
                              windows=n_alive):
                 (bb, bbw, alen, begin, end, q, qw8, lq, w_read, ovf) = \

@@ -1,0 +1,76 @@
+"""Durable file writes: the one place the tmp+rename+fsync dance lives.
+
+Three call sites used to hand-roll (or skip) crash-safe output: bench.py's
+JSON artifact, the tracer's JSONL finalization (obs/trace.py), and the
+resilience checkpoint store (resilience/checkpoint.py). They now share
+these helpers, so every file the toolchain promises to be "complete or
+absent" goes through the same sequence:
+
+1. write to ``<path>.tmp.<pid>`` in the destination directory (same
+   filesystem, so the rename is atomic),
+2. flush + ``os.fsync`` the tmp file (data durable before it becomes
+   visible),
+3. ``os.replace`` onto the final name (readers see old-or-new, never a
+   torn file),
+4. best-effort fsync of the directory (the rename itself durable).
+
+Appending stores (the checkpoint shard/manifest) instead use
+:func:`append_fsync` per record and rely on record ordering for
+atomicity — the caller documents which write commits.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Union
+
+
+def fsync_dir(path: str) -> None:
+    """Best-effort directory fsync so a rename/append survives power
+    loss; silently skipped where directories cannot be opened (e.g.
+    some network filesystems)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    """Write ``data`` to ``path`` atomically (tmp + fsync + rename)."""
+    d = os.path.dirname(os.path.abspath(path))
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as fh:
+        fh.write(data)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+    fsync_dir(d)
+
+
+def atomic_write_text(path: str, text: str,
+                      encoding: str = "utf-8") -> None:
+    atomic_write_bytes(path, text.encode(encoding))
+
+
+def atomic_finalize(tmp_path: str, final_path: str) -> None:
+    """Promote an already-written (and closed) tmp file to its final
+    name atomically. The caller is responsible for having fsync'd the
+    tmp file's contents if it needs durability, not just atomicity."""
+    os.replace(tmp_path, final_path)
+    fsync_dir(os.path.dirname(os.path.abspath(final_path)))
+
+
+def append_fsync(fh, data: Union[bytes, str]) -> int:
+    """Append one record to an open file and make it durable; returns
+    the record's start offset (the caller's manifest pointer)."""
+    off = fh.tell()
+    fh.write(data)
+    fh.flush()
+    os.fsync(fh.fileno())
+    return off

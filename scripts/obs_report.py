@@ -30,6 +30,9 @@ KIND_REQUIRED_ATTRS = {
     "transfer": ("bytes", "dir"),
     "stage": ("items", "busy_s", "stall_s"),
     "queue": ("peak", "capacity", "items"),
+    "retry": ("attempt", "error"),
+    "fault": ("index", "action"),
+    "checkpoint": ("tid", "bytes"),
 }
 
 # Span intervals are rounded to 1e-6 on write and a parent's clock stops
@@ -180,6 +183,7 @@ def render(tr: Dict[str, object], out=sys.stdout) -> None:
 
     m = tr["metrics"]
     _render_pipeline(m, out)
+    _render_resilience(m, by_kind, out)
     if m:
         keys = [k for k in sorted(m) if k != "ev"]
         print("\nmetrics:", file=out)
@@ -236,6 +240,42 @@ def _render_pipeline(m, out) -> None:
     if eff is not None:
         print(f"overlap efficiency: {float(eff):.3f} "
               "(compute busy / pipeline wall)", file=out)
+
+
+def _render_resilience(m, by_kind, out) -> None:
+    """The "Resilience" section: retry/fault/degradation/checkpoint
+    counters plus the per-site retry spans, all from the ``res_*``
+    metrics and ``retry``/``fault``/``checkpoint`` spans the resilience
+    package records. Quiet runs (no res_* activity) print nothing."""
+    m = m or {}
+    res = {k: v for k, v in m.items() if k.startswith("res_")}
+    spans = (by_kind.get("retry", []) + by_kind.get("fault", []) +
+             by_kind.get("checkpoint", []))
+    if not res and not spans:
+        return
+    print(f"\nresilience: retries={int(m.get('res_retry_total', 0))}  "
+          f"exhausted={int(m.get('res_retry_exhausted', 0))}  "
+          f"faults={int(m.get('res_fault_injected_total', 0))}  "
+          f"degraded_windows={int(m.get('res_degraded_windows', 0))}",
+          file=out)
+    sites = sorted(k[len("res_retry_site_"):] for k in res
+                   if k.startswith("res_retry_site_"))
+    if sites:
+        print(f"{'site':>24}  {'retries':>7}  {'faults':>6}", file=out)
+        for site in sites:
+            print(f"{site:>24}  "
+                  f"{int(res.get(f'res_retry_site_{site}', 0)):>7}  "
+                  f"{int(res.get(f'res_fault_site_{site}', 0)):>6}",
+                  file=out)
+    backoff = float(m.get("res_retry_backoff_s", 0.0))
+    if backoff:
+        print(f"backoff slept: {backoff:.3f}s", file=out)
+    commits = int(m.get("res_ckpt_commits", 0))
+    skips = int(m.get("res_ckpt_skips", 0))
+    if commits or skips or int(m.get("res_ckpt_resumes", 0)):
+        print(f"checkpoint: commits={commits}  resumed_skips={skips}  "
+              f"bytes={_fmt_bytes(float(m.get('res_ckpt_bytes', 0)))}",
+              file=out)
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -1,0 +1,399 @@
+"""Resilience subsystem tests: retry/backoff, fault injection,
+checkpoint/resume (racon_tpu/resilience/, docs/RESILIENCE.md)."""
+
+import contextlib
+import io
+import json
+import os
+
+import numpy as np
+import pytest
+
+from racon_tpu.obs import metrics as obs_metrics
+from racon_tpu.resilience import checkpoint as ckpt
+from racon_tpu.resilience import faults, retry
+
+BASES = np.frombuffer(b"ACGT", np.uint8)
+
+
+@pytest.fixture(autouse=True)
+def resilience_sandbox(monkeypatch):
+    """Keep the process-global injector/policy/registry out of other
+    tests (and other tests' env out of these)."""
+    monkeypatch.delenv(retry.ENV_RETRY, raising=False)
+    monkeypatch.delenv(faults.ENV_FAULTS, raising=False)
+    retry.configure(None)
+    faults.configure(None)
+    obs_metrics.reset()
+    yield
+    retry.configure(None)
+    faults.configure(None)
+    obs_metrics.reset()
+
+
+# ----------------------------------------------------------- retry policy
+
+
+def test_backoff_schedule_deterministic():
+    a = retry.RetryPolicy(attempts=5, base=0.05, seed=3)
+    b = retry.RetryPolicy(attempts=5, base=0.05, seed=3)
+    assert a.schedule("h2d/chunk") == b.schedule("h2d/chunk")
+    # Jitter is per-site: same policy, different site, different phase.
+    assert a.schedule("h2d/chunk") != a.schedule("d2h/chunk")
+    # Exponential growth under the cap, within the jitter band.
+    sched = a.schedule("h2d/chunk")
+    assert len(sched) == 4
+    for k, d in enumerate(sched, 1):
+        ideal = min(0.05 * 2.0 ** (k - 1), a.max_delay)
+        assert ideal * 0.9 <= d <= ideal * 1.1
+
+
+def test_backoff_cap_and_no_jitter():
+    p = retry.RetryPolicy(attempts=10, base=1.0, multiplier=4.0,
+                          max_delay=2.5, jitter=0.0)
+    assert p.schedule()[-1] == 2.5
+    assert p.delay(1) == 1.0            # jitter=0: exact
+
+
+def test_policy_rejects_zero_attempts():
+    with pytest.raises(ValueError, match="invalid attempts"):
+        retry.RetryPolicy(attempts=0)
+
+
+def test_default_policy_env(monkeypatch):
+    monkeypatch.setenv(retry.ENV_RETRY, "attempts=7,base=0.2,seed=9")
+    retry.configure(None)
+    pol = retry.default_policy()
+    assert (pol.attempts, pol.base, pol.seed) == (7, 0.2, 9)
+    monkeypatch.setenv(retry.ENV_RETRY, "attempts")
+    retry.configure(None)
+    with pytest.raises(ValueError, match="invalid RACON_TPU_RETRY"):
+        retry.default_policy()
+
+
+# ------------------------------------------------------------- retry.call
+
+
+def test_call_recovers_from_transient_failures():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionError("tunnel hiccup")
+        return 42
+
+    pol = retry.RetryPolicy(attempts=4, base=0.0, jitter=0.0)
+    assert retry.call("t/site", flaky, policy=pol) == 42
+    assert len(calls) == 3
+    snap = obs_metrics.registry().snapshot()
+    assert snap["res_retry_total"] == 2
+    assert snap["res_retry_site_t_site"] == 2
+
+
+def test_call_propagates_nontransient_immediately():
+    calls = []
+
+    def buggy():
+        calls.append(1)
+        raise KeyError("logic error")
+
+    with pytest.raises(KeyError):
+        retry.call("t/site", buggy,
+                   policy=retry.RetryPolicy(attempts=4, base=0.0))
+    assert len(calls) == 1
+    assert "res_retry_total" not in obs_metrics.registry().snapshot()
+
+
+def test_call_exhaustion_degradation_signal():
+    def always_down():
+        raise TimeoutError("still down")
+
+    pol = retry.RetryPolicy(attempts=3, base=0.0, jitter=0.0)
+    with pytest.raises(retry.RetryExhausted) as ei:
+        retry.call("d2h/chunk", always_down, policy=pol)
+    assert ei.value.site == "d2h/chunk"
+    assert ei.value.attempts == 3
+    assert isinstance(ei.value.__cause__, TimeoutError)
+    snap = obs_metrics.registry().snapshot()
+    assert snap["res_retry_exhausted"] == 1
+    assert snap["res_retry_total"] == 2     # last try isn't "retried"
+
+
+def test_call_runs_injector_inside_retry_loop():
+    """The acceptance scenario: a fault plan hitting the first N call
+    indices at a site is absorbed by N retries of one logical call."""
+    faults.configure("h2d/chunk:0,1,2")
+    pol = retry.RetryPolicy(attempts=4, base=0.0, jitter=0.0)
+    assert retry.call("h2d/chunk", lambda: "ok", policy=pol) == "ok"
+    snap = obs_metrics.registry().snapshot()
+    assert snap["res_retry_total"] == 3
+    assert snap["res_fault_injected_total"] == 3
+
+
+# --------------------------------------------------------- fault injector
+
+
+def test_injector_explicit_indices():
+    inj = faults.FaultInjector("x/y:0,2")
+    with pytest.raises(faults.InjectedFault) as ei:
+        inj.check("x/y")
+    assert (ei.value.site, ei.value.index) == ("x/y", 0)
+    inj.check("x/y")                        # index 1: clean
+    with pytest.raises(faults.InjectedFault):
+        inj.check("x/y")                    # index 2
+    inj.check("other/site")                 # unlisted site: never fires
+    assert inj.counts() == {"x/y": 3, "other/site": 1}
+    assert [f[1] for f in inj.fired] == [0, 2]
+
+
+def test_injector_probability_is_seed_deterministic():
+    def pattern(seed):
+        inj = faults.FaultInjector(f"s:p=0.5;seed={seed}")
+        out = []
+        for _ in range(64):
+            try:
+                inj.check("s")
+                out.append(0)
+            except faults.InjectedFault:
+                out.append(1)
+        return out
+
+    assert pattern(1) == pattern(1)
+    assert pattern(1) != pattern(2)
+    assert 10 < sum(pattern(1)) < 54        # roughly fair coin
+
+
+def test_injector_spec_errors():
+    for bad in ("h2d/chunk", "s:p=1.5", "s:x,y", "s:0!explode",
+                "seed=abc", ":0"):
+        with pytest.raises(faults.FaultSpecError):
+            faults.FaultInjector(bad)
+
+
+def test_maybe_fault_unarmed_is_noop(monkeypatch):
+    faults.configure(None)
+    faults.maybe_fault("h2d/chunk")         # no injector: must not raise
+
+
+# ------------------------------------------------------------- checkpoint
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    d = str(tmp_path / "ck")
+    with ckpt.CheckpointStore.create(d, "fp1") as store:
+        store.commit(0, b"c0 LN:i:5", b"ACGTA")
+        store.commit_dropped(1)
+        store.commit(2, b"c2", b"TTT")
+
+    res = ckpt.CheckpointStore.resume(d, "fp1")
+    assert sorted(res.committed) == [0, 1, 2]
+    assert res.read_emitted(0) == b">c0 LN:i:5\nACGTA\n"
+    assert res.read_emitted(1) is None
+    assert res.read_emitted(2) == b">c2\nTTT\n"
+    res.close()
+    snap = obs_metrics.registry().snapshot()
+    assert snap["res_ckpt_commits"] == 3
+    assert snap["res_ckpt_resumes"] == 1
+
+
+def test_checkpoint_torn_tail_and_orphan_shard_recovery(tmp_path):
+    d = str(tmp_path / "ck")
+    store = ckpt.CheckpointStore.create(d, "fp1")
+    store.commit(0, b"c0", b"AAAA")
+    store.commit(1, b"c1", b"CCCC")
+    store.close()
+    # Crash between shard append and manifest append: orphaned shard
+    # bytes plus a torn (newline-less, half-written) manifest record.
+    with open(store.shard_path, "ab") as fh:
+        fh.write(b">c2\nGG")
+    with open(store.manifest_path, "ab") as fh:
+        fh.write(b'{"ev": "contig", "tid": 2, "off')
+
+    res = ckpt.CheckpointStore.resume(d, "fp1")
+    assert sorted(res.committed) == [0, 1]
+    assert res.read_emitted(1) == b">c1\nCCCC\n"
+    # Shard truncated back to the last referenced byte...
+    assert os.path.getsize(res.shard_path) == len(b">c0\nAAAA\n"
+                                                  b">c1\nCCCC\n")
+    # ...and the manifest rewritten to the valid prefix.
+    lines = open(res.manifest_path, "rb").read().splitlines()
+    assert len(lines) == 3 and json.loads(lines[0])["ev"] == "begin"
+    res.close()
+
+
+def test_checkpoint_fingerprint_mismatch_refuses(tmp_path):
+    d = str(tmp_path / "ck")
+    ckpt.CheckpointStore.create(d, "fp1").close()
+    with pytest.raises(ckpt.CheckpointError, match="refusing to resume"):
+        ckpt.CheckpointStore.resume(d, "fp2")
+    with pytest.raises(ckpt.CheckpointError, match="unreadable"):
+        ckpt.CheckpointStore.resume(str(tmp_path / "nope"), "fp1")
+
+
+def test_run_fingerprint_sensitivity(tmp_path):
+    p = tmp_path / "in.fasta"
+    p.write_bytes(b">a\nACGT\n")
+    base = ckpt.run_fingerprint({"match": 5}, [str(p)])
+    assert base == ckpt.run_fingerprint({"match": 5}, [str(p)])
+    assert base != ckpt.run_fingerprint({"match": 6}, [str(p)])
+    p.write_bytes(b">a\nACGA\n")
+    assert base != ckpt.run_fingerprint({"match": 5}, [str(p)])
+
+
+# ------------------------------------------- degradation + CLI integration
+
+
+def _mutate(rng, truth):
+    out = []
+    for b in truth:
+        r = rng.random()
+        if r < 0.04:
+            continue
+        out.append(int(BASES[rng.integers(0, 4)]) if r < 0.08 else int(b))
+    return bytes(out)
+
+
+def _build_windows(n, seed=0, coverage=5, wlen=80):
+    from racon_tpu.models.window import Window, WindowType
+    rng = np.random.default_rng(seed)
+    ws = []
+    for i in range(n):
+        truth = BASES[rng.integers(0, 4, wlen)]
+        backbone = _mutate(rng, truth)
+        qual = bytes(rng.integers(43, 63, len(backbone), dtype=np.uint8))
+        w = Window(i, i % 3, WindowType.TGS, backbone, qual)
+        for _ in range(coverage):
+            lay = _mutate(rng, truth)
+            lq = bytes(rng.integers(43, 63, len(lay), dtype=np.uint8))
+            w.add_layer(lay, lq, 0, len(backbone) - 1)
+        ws.append(w)
+    return ws
+
+
+def test_degradation_to_host_is_bit_identical():
+    """Retry exhaustion at a transfer site must not change output: the
+    chunk's windows reroute to the host path, which is bit-identical to
+    the device path by design."""
+    from racon_tpu.ops.poa import PoaEngine
+
+    clean = _build_windows(8, seed=5)
+    PoaEngine(backend="jax", log=io.StringIO()).consensus_windows(clean)
+
+    retry.configure(retry.RetryPolicy(attempts=2, base=0.0, jitter=0.0))
+    faults.configure("h2d/chunk:p=1.0")     # every upload attempt fails
+    degraded = _build_windows(8, seed=5)
+    log = io.StringIO()
+    PoaEngine(backend="jax", log=log).consensus_windows(degraded)
+
+    assert [w.consensus for w in degraded] == \
+        [w.consensus for w in clean]
+    assert "host path" in log.getvalue()
+    snap = obs_metrics.registry().snapshot()
+    assert snap["res_retry_exhausted"] >= 1
+    assert snap["res_degraded_windows"] >= 1
+
+
+def _write_inputs(d, n_contigs=2, n_reads=6, clen=300):
+    rng = np.random.default_rng(11)
+    drafts, reads, paf = [], [], []
+    for ci in range(n_contigs):
+        truth = BASES[rng.integers(0, 4, clen)]
+        draft = _mutate(rng, truth)
+        drafts.append(b">c%d\n%s\n" % (ci, draft))
+        for i in range(n_reads):
+            r = _mutate(rng, truth)
+            name = f"c{ci}r{i}"
+            reads.append(b">" + name.encode() + b"\n" + r + b"\n")
+            paf.append(f"{name}\t{len(r)}\t0\t{len(r)}\t+\tc{ci}"
+                       f"\t{len(draft)}\t0\t{len(draft)}"
+                       f"\t{min(len(r), len(draft))}"
+                       f"\t{max(len(r), len(draft))}\t60")
+    (d / "draft.fasta").write_bytes(b"".join(drafts))
+    (d / "reads.fasta").write_bytes(b"".join(reads))
+    (d / "ovl.paf").write_text("\n".join(paf) + "\n")
+
+
+def _run_cli(d, *extra):
+    from racon_tpu import cli
+
+    class _Capture(io.StringIO):
+        pass
+
+    stdout = _Capture()
+    stdout.buffer = io.BytesIO()
+    err = io.StringIO()
+    with contextlib.redirect_stdout(stdout), \
+            contextlib.redirect_stderr(err):
+        rc = cli.main(["--backend", "jax", *extra,
+                       str(d / "reads.fasta"), str(d / "ovl.paf"),
+                       str(d / "draft.fasta")])
+    return rc, stdout.buffer.getvalue(), err.getvalue()
+
+
+def test_cli_resume_byte_identity(tmp_path):
+    """The resume contract on the CLI surface: a completed checkpointed
+    run re-emits byte-identically from the shard; a truncated manifest
+    (simulated kill) resumes and still matches; a changed config
+    refuses to resume."""
+    _write_inputs(tmp_path)
+    ck = str(tmp_path / "ck")
+
+    rc, base, _ = _run_cli(tmp_path)
+    assert rc == 0 and base.count(b">") == 2
+
+    rc, fresh, _ = _run_cli(tmp_path, "--checkpoint-dir", ck)
+    assert rc == 0 and fresh == base
+
+    rc, resumed, err = _run_cli(tmp_path, "--checkpoint-dir", ck,
+                                "--resume")
+    assert rc == 0 and resumed == base
+    assert "resuming: 2 contig(s)" in err
+
+    # Kill simulation: drop the last manifest record; its contig must
+    # recompute on resume with identical bytes.
+    man = os.path.join(ck, ckpt.MANIFEST_NAME)
+    lines = open(man, "rb").read().splitlines(keepends=True)
+    open(man, "wb").write(b"".join(lines[:-1]))
+    rc, partial, _ = _run_cli(tmp_path, "--checkpoint-dir", ck,
+                              "--resume")
+    assert rc == 0 and partial == base
+
+    rc, _, err = _run_cli(tmp_path, "--checkpoint-dir", ck, "--resume",
+                          "--match", "6")
+    assert rc == 1 and "refusing to resume" in err
+
+
+def test_cli_resume_requires_checkpoint_dir(tmp_path):
+    _write_inputs(tmp_path)
+    rc, _, err = _run_cli(tmp_path, "--resume")
+    assert rc == 1 and "--resume requires --checkpoint-dir" in err
+
+
+@pytest.mark.ava
+def test_ava_golden_resume_byte_identity(tmp_path):
+    """Resume byte-identity on the reference acceptance inputs (the ava
+    golden config tests/test_polisher.py gates on): full run vs
+    checkpointed run vs resumed run, all byte-identical."""
+    d = "/root/reference/test/data"
+    if not os.path.isdir(d):
+        pytest.skip("reference dataset not available")
+    from racon_tpu import cli
+
+    def run(*extra):
+        stdout = io.StringIO()
+        stdout.buffer = io.BytesIO()
+        with contextlib.redirect_stdout(stdout), \
+                contextlib.redirect_stderr(io.StringIO()):
+            rc = cli.main([
+                "--backend", "jax", *extra,
+                os.path.join(d, "sample_reads.fastq.gz"),
+                os.path.join(d, "sample_overlaps.paf.gz"),
+                os.path.join(d, "sample_layout.fasta.gz")])
+        assert rc == 0
+        return stdout.buffer.getvalue()
+
+    ck = str(tmp_path / "ck")
+    base = run()
+    assert run("--checkpoint-dir", ck) == base
+    assert run("--checkpoint-dir", ck, "--resume") == base
