@@ -109,20 +109,33 @@ def main():
     eng.consensus_windows(build_windows(n_windows, coverage, wlen, seed=99))
 
     # End-to-end: pipelined (chunk i+1's h2d overlaps chunk i's compute).
-    # The registry resets after warmup so the transfer extras (h2d/d2h
-    # bytes, seconds, effective bandwidth — "tunnel weather" as a
-    # number) describe exactly the measured e2e run.
-    windows = build_windows(n_windows, coverage, wlen)
-    eng = PoaEngine(backend=backend)
-    obs_metrics.reset()
-    enable_compile_cache()            # re-record cache entry baseline
-    t0 = time.perf_counter()
-    with tracer.span("run", "bench_e2e", n_windows=n_windows):
-        n_polished = eng.consensus_windows(windows)
-    dt = time.perf_counter() - t0
-    assert n_polished == n_windows
+    # metric_version 6: MEDIAN of RACON_TPU_BENCH_E2E_REPS (default 3)
+    # reps — the tunnel's minute-scale bandwidth swings made single-shot
+    # e2e rates mostly weather (97-213 w/s across four same-code runs,
+    # PROFILE.md round 5). Each rep rebuilds its windows OUTSIDE the
+    # timer and runs the identical workload (same seed); per-rep rates
+    # ride along in e2e_rep_windows_per_sec so the spread stays visible.
+    # The registry resets before every rep, so the transfer extras (h2d/
+    # d2h bytes, seconds, effective bandwidth) describe exactly the LAST
+    # measured run.
+    e2e_reps = max(1, int(os.environ.get("RACON_TPU_BENCH_E2E_REPS", "3")))
+    e2e_rates = []
+    for rep in range(e2e_reps):
+        windows = build_windows(n_windows, coverage, wlen)
+        eng = PoaEngine(backend=backend)
+        obs_metrics.reset()
+        enable_compile_cache()        # re-record cache entry baseline
+        t0 = time.perf_counter()
+        with tracer.span("run", "bench_e2e", n_windows=n_windows,
+                         rep=rep):
+            n_polished = eng.consensus_windows(windows)
+        dt = time.perf_counter() - t0
+        assert n_polished == n_windows
+        e2e_rates.append(n_windows / dt)
     e2e_transfers = obs_metrics.transfer_extras()
     e2e_transfers = {f"e2e_{k}": v for k, v in e2e_transfers.items()}
+    e2e_transfers["e2e_rep_windows_per_sec"] = \
+        [round(r, 2) for r in e2e_rates]
 
     # Sanity: consensus must actually polish (each window was built from a
     # 10%-error backbone; consensus should be near the truth, i.e. differ
@@ -130,7 +143,7 @@ def main():
     n_changed = sum(1 for w in windows if w.consensus != bytes(w.backbone))
     assert n_changed > n_windows * 0.9, "consensus did not polish"
 
-    e2e = n_windows / dt
+    e2e = float(np.median(e2e_rates))
 
     # Streamed end-to-end: the same workload through the streaming
     # executor (racon_tpu/pipeline/ — build/pack/h2d/compute stage
@@ -166,6 +179,18 @@ def main():
     # numbers were noise.
     compute = e2e
     sched_extras = {}
+    probe_extras = {}
+    if backend == "jax":
+        # Tunnel h2d bandwidth probe: one warm 8 MiB device_put timed to
+        # completion — the tunnel-weather denominator published next to
+        # the e2e rates it explains (production-attached TPUs should
+        # read hundreds of MB/s here; this env's tunnel reads 1.4-7).
+        probe = np.zeros(8 * 1024 * 1024, np.uint8)
+        jax.block_until_ready(jax.device_put(probe))        # warm path
+        t1 = time.perf_counter()
+        jax.block_until_ready(jax.device_put(probe))
+        probe_extras["h2d_probe_mb_per_s"] = round(
+            8.0 / max(time.perf_counter() - t1, 1e-9), 2)
     if backend == "jax":
         from racon_tpu.ops.device_poa import (ChunkPlan, run_caps,
                                               _use_pallas,
@@ -180,12 +205,20 @@ def main():
         plan = ChunkPlan(sub, lq_cap=lq_cap, la_cap=la_cap)
         job_h, win_h = plan.packed_bufs()
         job_buf, win_buf = jax.device_put((job_h, win_h))
+        sc = tuple(eng._round_scales(eng.refine_rounds + 1))
+        # Same adaptive gate as dispatch_chunk, so the fixed-engine rate
+        # times the production chunk program (adaptive round exit on by
+        # default; RACON_TPU_ADAPTIVE=0 restores the unrolled chain).
         kw = dict(match=5, mismatch=-4, gap=-8,
-                  ins_scale=tuple(eng._round_scales(eng.refine_rounds + 1)),
+                  ins_scale=sc,
                   Lq=plan.Lq,
                   n_win=plan.n_win, LA=plan.LA,
                   pallas=_use_pallas(plan.B, plan.Lq, plan.LA),
-                  band_w=plan.band_w, rounds=eng.refine_rounds + 1)
+                  band_w=plan.band_w, rounds=eng.refine_rounds + 1,
+                  adaptive=(os.environ.get("RACON_TPU_ADAPTIVE", "")
+                            not in ("0", "false")
+                            and eng.refine_rounds + 1 >= 3
+                            and len(set(sc[:-1])) <= 1))
         out = device_chunk_packed(job_buf, win_buf, **kw)
         np.asarray(out[:1])                       # compile + sync
         reps = 3
@@ -216,9 +249,28 @@ def main():
     # reflects the tunnel-fed rate while compute-only is the chip rate;
     # both are reported.
     from racon_tpu.utils.jaxcache import cache_extras
+    # Adaptive-round telemetry (collect_chunk increments these whenever a
+    # chunk's d2h lands): executed vs scheduled refinement rounds and how
+    # many chunks exited the device round loop early.
+    adaptive_extras = {
+        k: v for k, v in obs_metrics.registry().snapshot().items()
+        if k.startswith("adaptive_")}
     extras = {**sched_extras, **e2e_transfers, **pipe_extras,
+              **probe_extras, **adaptive_extras,
               **cache_extras(), **obs_metrics.resilience_extras()}
     out = {
+        # metric_version 6: same primary value as versions 2-5
+        # (compute-only windows/s of a warm production chunk). New in 6:
+        # the e2e rate is the MEDIAN of RACON_TPU_BENCH_E2E_REPS reps
+        # (per-rep rates in e2e_rep_windows_per_sec), an 8 MiB h2d
+        # bandwidth probe rides along as h2d_probe_mb_per_s, and the
+        # adaptive round-exit counters (adaptive_rounds_executed /
+        # _scheduled / _early_exits) report how many refinement rounds
+        # the chunks actually ran vs had scheduled (RACON_TPU_ADAPTIVE,
+        # default on). The chunk program itself changed this round
+        # (dual-column packed walk + i32-packed band slices + adaptive
+        # exit, all bit-identity-gated), so compute-rate deltas vs
+        # version 5 are real perf, not metric drift.
         # metric_version 5: same primary value as versions 2/3/4. New
         # in 5: res_* resilience extras (retry/fault/degradation/
         # checkpoint counters from racon_tpu/resilience/) ride along —
@@ -239,7 +291,7 @@ def main():
         # fixed_engine_windows_per_sec. Bump this whenever the primary
         # value's definition changes, so round-over-round comparisons
         # can't silently mix metrics.
-        "metric_version": 5,
+        "metric_version": 6,
         "metric": f"POA windows/sec/chip, compute-only (direct-timed warm "
                   f"production chunk, convergence-scheduled refinement "
                   f"rounds — racon_tpu/sched/, telemetry in sched_* "

@@ -37,6 +37,10 @@ fi
 echo "[ci] multi-chip dryrun (8 virtual devices)"
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
+echo "[ci] interpreter-mode kernel + dual-column walk smoke"
+python -m pytest tests/test_kernels_interpret.py tests/test_colwalk.py \
+  -q -m ''
+
 echo "[ci] two-shape device-engine smoke"
 python scripts/two_shape_smoke.py
 

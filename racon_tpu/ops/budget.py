@@ -1,0 +1,67 @@
+"""Device-path element and VMEM budgets — derived once, shared.
+
+The consensus engine (racon_tpu/ops/device_poa.py) and the overlap
+aligner (racon_tpu/ops/ovl_align.py) both admit work against a cap on
+the forward kernels' per-plane cell count (B * Lq * W elements of the
+dirs/nxt tensors). Round 5 shipped that cap as two hand-maintained
+literals — 1.6e9 in the consensus engine, 1.9e9 re-derived in the
+overlap aligner — and the 0.7% gap silently routed EVERY 8 kb genome
+overlap (128 x 8192 x 1536 = 1.61e9 elements) to the native fallback
+(PROFILE.md round 5). This module derives the cap from the actual
+constraints so the two paths cannot drift apart again:
+
+1. **int32 flat index.** The column walk (racon_tpu/ops/colwalk.py) and
+   the legacy traceback address the cell tensors through a flattened
+   int32 index, so the element count must stay below 2^31.
+2. **HBM single-buffer ceiling.** The runtime rejects single buffers of
+   2 GB and above, so the element count times the cell byte width must
+   stay below 2^31 bytes. At uint8 cells (both planes of the dual-column
+   layout ship as SEPARATE uint8 tensors, each under the cap on its own)
+   this coincides with (1); a packed uint16 cell layout would halve the
+   admissible geometry here — which is exactly why the dual-column
+   metadata is a second u8 plane and not a widened cell word.
+
+A 10% margin keeps slack for XLA padding/layout overhead while still
+admitting the genome geometry the 1.6e9 literal rejected.
+
+VMEM admission for the band kernel's long-read tiles lives here too
+(:func:`vmem_est`), consumed by ovl_align's tile picker and bucket
+admission. tests/test_budget.py pins the boundary geometries.
+"""
+
+from __future__ import annotations
+
+# Constraint (1): flat gather/scatter indices are int32 on device.
+INT32_INDEX_ELEMS = 2 ** 31
+# Constraint (2): single HBM buffer allocations below 2 GB.
+BUFFER_BYTES = 2 ** 31
+# Headroom for XLA padding/layout overhead.
+_MARGIN_NUM, _MARGIN_DEN = 9, 10
+
+
+def max_dir_elems(cell_bytes: int = 1) -> int:
+    """Element cap for ONE forward-kernel cell plane of ``cell_bytes``-
+    wide cells. ``max_dir_elems(1)`` (~1.93e9) admits the 8 kb-read
+    genome overlap geometry (1.61e9); ``max_dir_elems(2)`` (~0.97e9)
+    would not — see the module docstring on why the dual-column walk
+    ships a second u8 plane instead of u16 cells."""
+    if cell_bytes < 1:
+        raise ValueError("[racon_tpu::budget] cell_bytes must be >= 1")
+    cap = min(INT32_INDEX_ELEMS, BUFFER_BYTES // cell_bytes)
+    return cap * _MARGIN_NUM // _MARGIN_DEN
+
+
+# Usable fraction of the ~16 MiB per-core VMEM scoped limit.
+VMEM_BUDGET = 12 * 1024 * 1024
+
+
+def vmem_est(W: int, Lq: int, ch: int) -> int:
+    """Band-kernel VMEM block-byte model at long-read geometry: the
+    (W+Lq, 128) int32 target window (int16 would halve it, but Mosaic
+    requires 8-aligned dynamic sublane slices below 32 bits), the
+    double-buffered (ch, W, 128) u8 dirs AND nxt blocks (the dual-column
+    walk's second plane doubled this term), and four W-tall 128-lane i32
+    rows (prev + packed NUC scratch + hlast + working row). Lane blocks
+    always pad to 128 on TPU, so shrinking the batch below 128 lanes
+    saves nothing — ch and the admission cap are the only levers."""
+    return 128 * (4 * (W + Lq) + W * (4 * ch + 16))

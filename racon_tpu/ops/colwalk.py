@@ -48,7 +48,8 @@ from racon_tpu.ops.cigar import DIAG, UP, LEFT  # noqa: F401 (UP: doc)
 from racon_tpu.ops.flat import PAD_OP, U_SAT
 
 
-def col_walk(cells, lq, lt, klo, t_off, *, LA: int, layout: str):
+def col_walk(cells, lq, lt, klo, t_off, *, LA: int, layout: str,
+             nxt=None):
     """Walk packed cells over the anchor-position grid.
 
     Args:
@@ -59,6 +60,18 @@ def col_walk(cells, lq, lt, klo, t_off, *, LA: int, layout: str):
       LA: static anchor padding length; the scan runs LA + 2 steps.
       layout: "band_t" [Lq, W, B] (Pallas band), "band" [Lq, B, W]
         (XLA band twin), "flat" [Lq, B, Lt] (both flat kernels).
+      nxt: optional matching uint8 tensor of predecessor metadata
+        (band kernels' second output plane): the nxt byte of cell
+        (i, j) packs the (up_run << 2 | consumer_dir) of the cell the
+        walk visits next after undoing (i, j)'s block. When given, the
+        walk undoes TWO anchor positions per dependent gather — the
+        scan's latency chain (serialized per-column HBM gathers,
+        PROFILE.md round 5's top remaining cost) halves. Bit-identical
+        to the single-column walk for every lane the exactness
+        certificates admit; flagged lanes (saturation / escape bound)
+        may emit differently but are re-polished on the host path in
+        both modes (their ``sat``/escape flags themselves are
+        identical).
 
     Returns dict of anchor-indexed arrays (all [B, LA+2] int16 except
     ``sat`` bool[B]); row p describes the walk step at j = p - t_off:
@@ -77,30 +90,34 @@ def col_walk(cells, lq, lt, klo, t_off, *, LA: int, layout: str):
     else:
         Lq, B, W = cells.shape           # W = Lt for flat layouts
     c1 = cells.reshape(-1)
+    n1 = None if nxt is None else nxt.reshape(-1)
     lane = jnp.arange(B, dtype=jnp.int32)
     lt = lt.astype(jnp.int32)
     lq = lq.astype(jnp.int32)
     t_off = t_off.astype(jnp.int32)
 
-    def substep(i, sat, p):
-        j = p - t_off
-        active = (j >= 0) & (j <= lt)
-        jc = jnp.clip(j, 0, lt)
-        # Packed byte of cell (i, j): row i-1 of the stored tensor.
+    def cell_idx(i, jc):
+        # Flat index of cell (i, jc)'s packed byte: row i-1 of the
+        # stored tensor (row 0 of the DP matrix has no stored cells).
         r = jnp.maximum(i - 1, 0)
         if layout == "flat":
             col = jnp.maximum(jc - 1, 0)
-            idx = r * (B * W) + lane * W + col
-        else:
-            x = jnp.clip(jc - i - klo, 0, W - 1)
-            if layout == "band_t":
-                idx = r * (B * W) + x * B + lane
-            else:
-                idx = r * (B * W) + lane * W + x
-        pv = jnp.take(c1, idx).astype(jnp.int32)
+            return r * (B * W) + lane * W + col
+        x = jnp.clip(jc - i - klo, 0, W - 1)
+        if layout == "band_t":
+            return r * (B * W) + x * B + lane
+        return r * (B * W) + lane * W + x
+
+    def undo(i, sat, p, u_raw, cdir_raw):
+        # Undo one anchor position given the (up_run, consumer_dir) pair
+        # of cell (i, j) — however it was fetched (direct gather, or the
+        # nxt plane of the PREVIOUS position's gather in dual mode).
+        j = p - t_off
+        active = (j >= 0) & (j <= lt)
+        jc = jnp.clip(j, 0, lt)
         readable = active & (i >= 1) & (jc >= 1)
-        u = jnp.where(readable, pv >> 4, 0)
-        cdir = jnp.where(readable, (pv >> 2) & 3, LEFT)
+        u = jnp.where(readable, u_raw, 0)
+        cdir = jnp.where(readable, cdir_raw, LEFT)
         newsat = readable & (u == U_SAT)
         is_j0 = active & (j == 0)
         # Gap j: the whole UP run in one step; at j == 0 every remaining
@@ -118,18 +135,52 @@ def col_walk(cells, lq, lt, klo, t_off, *, LA: int, layout: str):
         out = jnp.stack([u_eff, top, cons, qi], axis=-1).astype(jnp.int16)
         return i_next, sat | newsat, out
 
+    def substep(i, sat, p):
+        j = p - t_off
+        jc = jnp.clip(j, 0, lt)
+        pv = jnp.take(c1, cell_idx(i, jc)).astype(jnp.int32)
+        return undo(i, sat, p, pv >> 4, (pv >> 2) & 3)
+
+    def dual_substep(i, sat, p_hi):
+        # Positions p_hi and p_hi - 1 off ONE dependent gather: the
+        # cells byte undoes p_hi as usual, and the nxt byte fetched at
+        # the SAME index carries the (u, cdir) the p_hi - 1 step needs
+        # (by the nxt contract it describes cell (i_mid, j - 1)). The
+        # one exception is the entry edge: while j_hi > lt the hi step
+        # is inactive and the clipped gather already read cell
+        # (i, lt) — exactly the byte the lo step's own gather would
+        # fetch — so the lo step unpacks the CELLS byte there instead.
+        j = p_hi - t_off
+        active_hi = (j >= 0) & (j <= lt)
+        jc = jnp.clip(j, 0, lt)
+        idx = cell_idx(i, jc)
+        pv = jnp.take(c1, idx).astype(jnp.int32)
+        nv = jnp.take(n1, idx).astype(jnp.int32)
+        i, sat, out_hi = undo(i, sat, p_hi, pv >> 4, (pv >> 2) & 3)
+        u_lo = jnp.where(active_hi, nv >> 2, pv >> 4)
+        c_lo = jnp.where(active_hi, nv & 3, (pv >> 2) & 3)
+        i, sat, out_lo = undo(i, sat, p_hi - 1, u_lo, c_lo)
+        return i, sat, out_hi, out_lo
+
     UNROLL = 4
 
     def step(carry, p0):
         # Several columns per scan iteration: the walk is a serialized
         # chain of tiny per-column ops whose cost is per-iteration
         # dispatch overhead, not arithmetic — unrolling divides the
-        # iteration count (PROFILE.md round 5).
+        # iteration count (PROFILE.md round 5). With the nxt plane, each
+        # iteration is UNROLL // 2 dependent gathers instead of UNROLL.
         i, sat = carry
         outs = []
-        for k in reversed(range(UNROLL)):
-            i, sat, out = substep(i, sat, p0 + k)
-            outs.append(out)
+        if nxt is None:
+            for k in reversed(range(UNROLL)):
+                i, sat, out = substep(i, sat, p0 + k)
+                outs.append(out)
+        else:
+            for k in (UNROLL - 1, UNROLL - 3):
+                i, sat, hi, lo = dual_substep(i, sat, p0 + k)
+                outs.append(hi)
+                outs.append(lo)
         # ONE stacked int16 ys, not a tuple of int16 arrays: a reverse
         # scan emitting a TUPLE of int16 ys miscompiles under XLA CPU jit
         # in jax 0.9 (wrong values vs disable_jit; int32 tuples and

@@ -38,9 +38,15 @@ from racon_tpu.models.window import Window, window_arrays
 from racon_tpu.ops.encode import ALPHABET
 from racon_tpu.ops import flat as flatmod
 from racon_tpu.ops.flat import PAD_OP
+from racon_tpu.ops.budget import max_dir_elems
 
-# Keep Lq * B * Lt under int32 flat-index range for the traceback gather.
-MAX_DIR_ELEMS = 1_600_000_000
+# Per-lane-tensor element budget for the dirs/nxt planes (the column
+# walk's flat gather index and the HBM single-buffer ceiling). Derived
+# in ONE place — racon_tpu/ops/budget.py — shared with ovl_align so the
+# two admission paths can never drift apart again (the former hand-set
+# 1.6e9 here vs the re-derived 1.9e9 there silently rejected the 8 kb
+# genome overlap geometry by 0.7%; PROFILE.md round 5).
+MAX_DIR_ELEMS = max_dir_elems(1)
 
 # Anchor slack for insertion growth across rounds. Consensus length
 # tracks backbone length within ~2% on real data; 64 covers that many
@@ -322,6 +328,43 @@ def _use_pallas(B: int, Lq: int, LA: int) -> bool:
     return B % TB == 0 and Lq % CH == 0 and LA % 128 == 0
 
 
+def _packed_byte_slice(tab, start, L: int):
+    """Batched contiguous byte slice via i32-packed dynamic_slice.
+
+    Equivalent to ``vmap(lambda s: dynamic_slice(tab, (s,), (L,)))`` for
+    a uint8 table with ``start >= 0`` and ``start + L <= tab.size``, but
+    each per-lane DMA moves L/4 + 1 int32 words instead of L bytes. The
+    band/tbuf build is bound by per-lane DMA *descriptor* latency, which
+    scales with element count, not bytes (PROFILE.md round 5's tband
+    cost) — packing 4 cells per word cuts it ~4x. The start&3 phase is
+    recovered from four STATIC byte slices with three selects; a
+    per-element phase gather here would reintroduce exactly the cost the
+    slice-mode build removed (scripts/ablate_gather_pack.py).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    # Worst case is phase 3: bytes [3, 3 + L) of the fetched window, so
+    # the window must span L + 3 bytes -> L // 4 + 2 words.
+    n4 = L // 4 + 2
+    # Round the table up to whole words plus two words of slack so the
+    # word slice covering any start phase stays in range:
+    #   (start >> 2) + n4 <= floor((start + L) / 4) + 2
+    #                     <= floor(size / 4) + 2.
+    pad = (-tab.shape[0]) % 4 + 8
+    tabp = jnp.concatenate([tab, jnp.zeros((pad,), tab.dtype)])
+    w32 = jax.lax.bitcast_convert_type(tabp.reshape(-1, 4), jnp.int32)
+    ws = jax.vmap(
+        lambda s: jax.lax.dynamic_slice(w32, (s,), (n4,)))(start >> 2)
+    by = jax.lax.bitcast_convert_type(ws, jnp.uint8).reshape(
+        ws.shape[0], n4 * 4)
+    ph = start & 3
+    out = by[:, 0:L]
+    for r_ in (1, 2, 3):
+        out = jnp.where((ph == r_)[:, None], by[:, r_:r_ + L], out)
+    return out
+
+
 def _lane_votes(bb, alen, begin, end, q, qw8, lq, w_read, win, *,
                 match, mismatch, gap, Lq, LA, pallas, band_w=0):
     """Job geometry + NW forward + column-walk + vote extraction for
@@ -373,22 +416,23 @@ def _lane_votes(bb, alen, begin, end, q, qw8, lq, w_read, win, *,
         okb = (rel >= 0) & (rel < lt[:, None])
         # Per-lane slices are CONTIGUOUS runs of the anchor table, so a
         # batched dynamic_slice (slice-mode gather) replaces the element
-        # gather — 26 ms vs 55 ms at bench shapes (PROFILE.md); the
-        # padding margins make every start index in-range, the okb mask
-        # reproduces the clip semantics bit-for-bit.
+        # gather — 26 ms vs 55 ms at bench shapes (PROFILE.md) — and the
+        # i32-packed variant moves 4 cells per descriptor word on top
+        # (_packed_byte_slice); the padding margins make every start
+        # index in-range, the okb mask reproduces the clip semantics
+        # bit-for-bit (every phase-spill byte it could expose is masked).
         tab = jnp.concatenate(
             [jnp.zeros((PW,), flat.dtype), flat,
              jnp.zeros((PW,), flat.dtype)])
         start = win * LA + t_off + klo + PW
-        sl = jax.vmap(
-            lambda s: jax.lax.dynamic_slice(tab, (s,), (PW,)))(start)
+        sl = _packed_byte_slice(tab, start, PW)
         tband = jnp.where(okb, sl, 7).astype(jnp.uint8)
         fwd = fw_dirs_band if pallas else fw_dirs_band_xla
-        dirs, hlast = fwd(tband, q.T, klo, lq,
-                          match=match, mismatch=mismatch, gap=gap,
-                          W=band_w)
+        dirs, nxt, hlast = fwd(tband, q.T, klo, lq,
+                               match=match, mismatch=mismatch, gap=gap,
+                               W=band_w)
         cols = col_walk(dirs, lq, lt, klo, t_off, LA=LA,
-                        layout="band_t" if pallas else "band")
+                        layout="band_t" if pallas else "band", nxt=nxt)
         # Escape bound (see nw.cpp): banded score must beat any path
         # that leaves the band, else the lane's window is re-polished on
         # the unbounded host path. Any out-of-band path carries at least
@@ -412,8 +456,7 @@ def _lane_votes(bb, alen, begin, end, q, qw8, lq, w_read, win, *,
         tab = jnp.concatenate(
             [flat, jnp.zeros((LA,), flat.dtype)])
         start = win * LA + t_off
-        sl = jax.vmap(
-            lambda s: jax.lax.dynamic_slice(tab, (s,), (LA,)))(start)
+        sl = _packed_byte_slice(tab, start, LA)
         tbuf = jnp.where(ok, sl, 7).astype(jnp.uint8)
         if pallas:
             from racon_tpu.ops.pallas.flat_kernel import fw_dirs_pallas
@@ -462,13 +505,17 @@ def _remap_state(codes, total, map_b, map_e, bb, alen, begin, end, win,
 
 def _round_core(bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf, *,
                 match, mismatch, gap, ins_scale, Lq, n_win,
-                LA, pallas, band_w=0, axis_name=None):
+                LA, pallas, band_w=0, detect=False, axis_name=None):
     """One alignment + merge round (traced body, single shard's view).
 
-    Returns (new_bb, new_bbw, new_alen, new_begin, new_end, cov, ovf).
-    ``ovf`` is a sticky per-window flag: consensus outgrew the padded
-    anchor width this round (or any earlier one) and was truncated —
-    the host must re-run those windows (the host path is unbounded).
+    Returns (new_bb, new_bbw, new_alen, new_begin, new_end, cov, ovf,
+    conv). ``ovf`` is a sticky per-window flag: consensus outgrew the
+    padded anchor width this round (or any earlier one) and was
+    truncated — the host must re-run those windows (the host path is
+    unbounded). ``conv`` is the per-window fixed-point flag
+    (device_merge.converged_windows) when ``detect`` is on, all-False
+    otherwise — the adaptive round exit in device_chunk_packed skips
+    remaining non-final rounds once every window is conv or ovf.
 
     Under shard_map the job (B) axis is sharded over ``axis_name`` while
     window arrays are replicated; the only collective is one psum of the
@@ -502,13 +549,27 @@ def _round_core(bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf, *,
     ovf = ovf | (total > LA)
     if wesc is not None:
         ovf = ovf | (wesc[:-1] > 0)
-    return new_bb, new_bbw, new_alen, nb, ne, cov, ovf
+    if detect:
+        # Same fixed-point predicate as the convergence scheduler
+        # (sched/rounds.py): span-change flags ride one extra membership
+        # matmul (and one extra psum under dp — nb/ne only exist after
+        # the coordinate maps, so they cannot ride the votes' psum).
+        chg = ((nb != begin) | (ne != end)).astype(jnp.float32)
+        wchg = dm.aggregate_flags(chg, win, n_win + 1)
+        if axis_name is not None:
+            wchg = jax.lax.psum(wchg, axis_name)
+        conv = dm.converged_windows(codes, total, bb[:-1], alen[:-1],
+                                    wchg[:-1])
+    else:
+        conv = jnp.zeros(n_win, dtype=bool)
+    return new_bb, new_bbw, new_alen, nb, ne, cov, ovf, conv
 
 
 device_round = functools.partial(
     __import__("jax").jit,
     static_argnames=("match", "mismatch", "gap", "ins_scale", "Lq",
-                     "n_win", "LA", "pallas", "band_w"))(_round_core)
+                     "n_win", "LA", "pallas", "band_w",
+                     "detect"))(_round_core)
 
 
 def round_band_width(band_w: int, r: int) -> int:
@@ -532,7 +593,7 @@ def round_band_width(band_w: int, r: int) -> int:
 
 
 def _make_round_fn(*, match, mismatch, gap, ins_scale, Lq, n_win, LA,
-                   pallas, band_w, mesh):
+                   pallas, band_w, mesh, detect=False):
     """One round callable: plain _round_core, or its dp-sharded shard_map
     when a mesh is given (the single place the sharding contract lives).
 
@@ -545,7 +606,8 @@ def _make_round_fn(*, match, mismatch, gap, ins_scale, Lq, n_win, LA,
     core = functools.partial(
         _round_core, match=match, mismatch=mismatch, gap=gap,
         ins_scale=ins_scale, Lq=Lq, n_win=n_win, LA=LA, pallas=pallas,
-        band_w=band_w, axis_name=None if mesh is None else "dp")
+        band_w=band_w, detect=detect,
+        axis_name=None if mesh is None else "dp")
     if mesh is None:
         return core
     from jax.sharding import PartitionSpec as P
@@ -555,7 +617,7 @@ def _make_round_fn(*, match, mismatch, gap, ins_scale, Lq, n_win, LA,
     return shard_map(
         core, mesh=mesh,
         in_specs=(rep, rep, rep, job, job, job, job, job, job, job, rep),
-        out_specs=(rep, rep, rep, job, job, rep, rep),
+        out_specs=(rep, rep, rep, job, job, rep, rep, rep),
         check_vma=False)
 
 
@@ -593,10 +655,11 @@ def _unpack_bufs(job_buf, win_buf, Lq: int, LA: int):
 @functools.partial(
     __import__("jax").jit,
     static_argnames=("match", "mismatch", "gap", "ins_scale", "Lq",
-                     "n_win", "LA", "pallas", "band_w", "rounds", "mesh"))
+                     "n_win", "LA", "pallas", "band_w", "rounds",
+                     "adaptive", "mesh"))
 def device_chunk_packed(job_buf, win_buf, *, match, mismatch, gap,
                         ins_scale, Lq, n_win, LA, pallas, band_w, rounds,
-                        mesh=None):
+                        adaptive=False, mesh=None):
     """One chunk end to end in ONE jit dispatch from TWO byte buffers.
 
     Inputs arrive as ChunkPlan.packed_bufs()' concatenated layouts (two
@@ -610,6 +673,18 @@ def device_chunk_packed(job_buf, win_buf, *, match, mismatch, gap,
 
     ``ins_scale`` may be a float or a per-round tuple of length
     ``rounds`` (PoaEngine passes a schedule — see its ins_scale_final).
+
+    ``adaptive`` (static; dispatch_chunk gates it on RACON_TPU_ADAPTIVE
+    and the schedule shape) rewrites the unrolled round chain as
+    round 0, a while_loop over the replayable middle rounds (shared
+    band width and scale — one trace), and the final round. The loop
+    exits as soon as EVERY window is converged or overflowed: skipped
+    middle rounds are exact replays for converged windows (the
+    convergence scheduler's proof, sched/rounds.py) and discarded work
+    for overflowed ones (host redo), so the packed output is
+    bit-identical to the full chain while a converged chunk pays
+    3 rounds instead of ``rounds``. Requires rounds >= 3 and uniform
+    non-final scales; the caller checks both.
     """
     import jax
     import jax.numpy as jnp
@@ -618,22 +693,60 @@ def device_chunk_packed(job_buf, win_buf, *, match, mismatch, gap,
         _unpack_bufs(job_buf, win_buf, Lq, LA)
 
     ovf = jnp.zeros(n_win, dtype=bool)
+    conv = jnp.zeros(n_win, dtype=bool)
     cov = None
 
     scales = ins_scale if isinstance(ins_scale, tuple) \
         else (ins_scale,) * rounds
 
-    def make_round(bw, sc):
+    def make_round(bw, sc, det):
         return _make_round_fn(
             match=match, mismatch=mismatch, gap=gap, ins_scale=sc,
             Lq=Lq, n_win=n_win, LA=LA, pallas=pallas, band_w=bw,
-            mesh=mesh)
+            mesh=mesh, detect=det)
 
-    for r in range(rounds):
-        bw = round_band_width(band_w, r)
-        bb, bbw, alen, begin, end, cov, ovf = make_round(bw, scales[r])(
-            bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf)
-    return _pack_body(bb[:-1], cov, alen[:-1], ovf)
+    if not adaptive:
+        for r in range(rounds):
+            bw = round_band_width(band_w, r)
+            bb, bbw, alen, begin, end, cov, ovf, conv = \
+                make_round(bw, scales[r], False)(
+                    bb, bbw, alen, begin, end, q, qw8, lq, w_read, win,
+                    ovf)
+        rexec = jnp.int32(rounds)
+    else:
+        # Round 0 (full band): detection cannot fire — its input anchor
+        # carries backbone quality weights and is not a replayable state
+        # (device_merge.converged_windows).
+        bb, bbw, alen, begin, end, cov, ovf, conv = \
+            make_round(round_band_width(band_w, 0), scales[0], False)(
+                bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf)
+        # Middle rounds 1..rounds-2: one executable (round_band_width is
+        # constant for r >= 1 and the non-final scales are uniform).
+        # Padded dummy windows (zero anchors, no lanes) reproduce their
+        # state from round 1 on, so the all-windows predicate terminates.
+        mid = make_round(round_band_width(band_w, 1), scales[1], True)
+
+        def cond(c):
+            k = c[0]
+            return (k < rounds - 1) & ~jnp.all(c[7] | c[8])
+
+        def body(c):
+            k, bb, bbw, alen, begin, end, cov, ovf, conv = c
+            bb, bbw, alen, begin, end, cov, ovf, conv = mid(
+                bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf)
+            return (k + 1, bb, bbw, alen, begin, end, cov, ovf, conv)
+
+        (k, bb, bbw, alen, begin, end, cov, ovf, conv) = \
+            jax.lax.while_loop(cond, body, (jnp.int32(1), bb, bbw, alen,
+                                            begin, end, cov, ovf, conv))
+        # Final round always runs (final-scale assembly).
+        bb, bbw, alen, begin, end, cov, ovf, conv = \
+            make_round(round_band_width(band_w, rounds - 1), scales[-1],
+                       False)(
+                bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf)
+        rexec = k + 1
+    return _pack_body(bb[:-1], cov, alen[:-1], ovf, rexec,
+                      jnp.int32(rounds))
 
 
 @functools.partial(
@@ -657,19 +770,26 @@ def device_round_sharded(bb, bbw, alen, begin, end, q, qw8, lq, w_read,
     return fn(bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf)
 
 
-def _pack_body(codes, cov, alen, ovf):
+def _pack_body(codes, cov, alen, ovf, rounds_exec, rounds_sched):
     """Flatten codes/cov/lengths/overflow into one uint8 buffer for a
     single d2h transfer (each synchronized pull pays ~13 ms tunnel
-    latency). The byte layout is the contract collect_chunk unpacks."""
+    latency). The byte layout is the contract collect_chunk unpacks.
+    ``rounds_exec``/``rounds_sched`` (int32 scalars, 8 trailing bytes)
+    record how many refinement rounds the chunk actually executed vs.
+    had scheduled — the adaptive early exit's telemetry rides the same
+    pull."""
     import jax
     import jax.numpy as jnp
     c16 = jnp.clip(cov, 0, 32767).astype(jnp.int16)
     tail = alen.astype(jnp.int32)
+    rr = jnp.stack([jnp.asarray(rounds_exec).astype(jnp.int32),
+                    jnp.asarray(rounds_sched).astype(jnp.int32)])
     return jnp.concatenate([
         codes.reshape(-1),
         jax.lax.bitcast_convert_type(c16, jnp.uint8).reshape(-1),
         jax.lax.bitcast_convert_type(tail, jnp.uint8).reshape(-1),
         ovf.astype(jnp.uint8),
+        jax.lax.bitcast_convert_type(rr, jnp.uint8).reshape(-1),
     ])
 
 
@@ -774,11 +894,21 @@ def dispatch_chunk(plan: ChunkPlan, *, match: int, mismatch: int,
             t0 = sync(job_buf, "h2d/job", t0)
             t0 = sync(win_buf, "h2d", t0)
         from racon_tpu.resilience.retry import call as retry_call
+        # Adaptive early exit: only meaningful with at least one
+        # skippable middle round, and only sound when every non-final
+        # round shares one scale (the replay argument; PoaEngine's
+        # schedule satisfies this by construction).
+        sc = ins_scale if isinstance(ins_scale, tuple) \
+            else (ins_scale,) * rounds
+        adaptive = (os.environ.get("RACON_TPU_ADAPTIVE", "")
+                    not in ("0", "false")
+                    and rounds >= 3 and len(set(sc[:-1])) <= 1)
         packed = retry_call(
             "dispatch/chunk", device_chunk_packed, job_buf, win_buf,
             match=match, mismatch=mismatch, gap=gap, ins_scale=ins_scale,
             Lq=plan.Lq, n_win=plan.n_win, LA=plan.LA,
-            pallas=pallas, band_w=band_w, rounds=rounds, mesh=mesh)
+            pallas=pallas, band_w=band_w, rounds=rounds,
+            adaptive=adaptive, mesh=mesh)
         obs_registry().inc("device_dispatches")
         if collect:
             t0 = sync(packed, "compute", t0)
@@ -812,7 +942,7 @@ def dispatch_chunk(plan: ChunkPlan, *, match: int, mismatch: int,
     scales = ins_scale if isinstance(ins_scale, tuple) \
         else (ins_scale,) * rounds
     for r in range(rounds):
-        bb, bbw, alen, begin, end, cov, ovf = rnd(
+        bb, bbw, alen, begin, end, cov, ovf, _ = rnd(
             bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf,
             match=match, mismatch=mismatch, gap=gap,
             ins_scale=scales[r], Lq=plan.Lq, n_win=plan.n_win,
@@ -824,7 +954,8 @@ def dispatch_chunk(plan: ChunkPlan, *, match: int, mismatch: int,
         stats["chunks"] = stats.get("chunks", 0) + 1
         stats["_t_pack"] = time.perf_counter()
 
-    return _pack_out(bb[:-1], cov, alen[:-1], ovf)
+    return _pack_out(bb[:-1], cov, alen[:-1], ovf,
+                     jnp.int32(rounds), jnp.int32(rounds))
 
 
 def collect_chunk(plan: ChunkPlan, packed, stats: Optional[dict] = None
@@ -860,7 +991,19 @@ def collect_chunk(plan: ChunkPlan, packed, stats: Optional[dict] = None
     codes_h = ph[:Nw * LA].reshape(Nw, LA)
     cov_h = ph[Nw * LA:3 * Nw * LA].view(np.int16).reshape(Nw, LA)
     alen_h = ph[3 * Nw * LA:3 * Nw * LA + 4 * Nw].view(np.int32)[:Nw]
-    ovf_h = ph[3 * Nw * LA + 4 * Nw:] != 0
+    base = 3 * Nw * LA + 4 * Nw
+    ovf_h = ph[base:base + Nw] != 0
+    rex = int(ph[base + Nw:base + Nw + 4].view(np.int32)[0])
+    rsch = int(ph[base + Nw + 4:base + Nw + 8].view(np.int32)[0])
+    from racon_tpu.obs.metrics import registry as obs_registry
+    reg = obs_registry()
+    reg.inc("adaptive_rounds_executed", rex)
+    reg.inc("adaptive_rounds_scheduled", rsch)
+    if rex < rsch:
+        reg.inc("adaptive_early_exits")
+    if stats is not None:
+        stats["rounds_exec"] = stats.get("rounds_exec", 0) + rex
+        stats["rounds_sched"] = stats.get("rounds_sched", 0) + rsch
 
     out_codes: List[Optional[bytes]] = []
     out_cov: List[Optional[np.ndarray]] = []

@@ -33,38 +33,29 @@ import functools
 import numpy as np
 
 from racon_tpu.ops.cigar import DIAG
-from racon_tpu.ops.device_poa import _round_up
+from racon_tpu.ops.device_poa import _packed_byte_slice, _round_up
 from racon_tpu.ops.pallas.band_kernel import TB   # lane grid (= chunk B)
-# Dirs-tensor element budget: the column walk's flat gather index must
-# stay under 2^31 and the dirs HBM buffer under the TPU's 2 GB
-# single-buffer ceiling. 1.9e9 leaves margin for both while admitting
-# the 8 kb-read genome geometry (128 x 8192 x 1536 = 1.61e9 — the
-# consensus engine's tighter 1.6e9 cap rejected it by 0.7% and silently
-# routed EVERY genome overlap to the native path, round-5 find).
-MAX_DIR_ELEMS = 1_900_000_000
-
-_VMEM_BUDGET = 12 * 1024 * 1024   # usable of the 16 MiB scoped limit
-
-
-def _vmem_est(W: int, Lq: int, ch: int) -> int:
-    """Band-kernel VMEM block-byte model at long-read geometry: the
-    (W+Lq, 128) int32 target window (int16 would halve it, but Mosaic
-    requires 8-aligned dynamic sublane slices below 32 bits), the
-    double-buffered (ch, W, 128) u8 dirs block, and four W-tall
-    128-lane i32 rows (prev + packed UC scratch + hlast + working row).
-    Lane blocks always pad to 128 on TPU, so shrinking the batch below
-    128 lanes saves nothing — ch and the admission cap are the only
-    levers."""
-    return 128 * (4 * (W + Lq) + W * (2 * ch + 16))
+from racon_tpu.ops.budget import (VMEM_BUDGET as _VMEM_BUDGET,
+                                  max_dir_elems, vmem_est as _vmem_est)
+# Dirs/nxt-plane element budget: the column walk's flat gather index
+# must stay under 2^31 and each plane's HBM buffer under the TPU's 2 GB
+# single-buffer ceiling. Derived in racon_tpu/ops/budget.py, SHARED with
+# the consensus engine — round 5's independently-maintained caps (1.6e9
+# there, 1.9e9 here) disagreed by 0.7% and silently routed EVERY 8 kb
+# genome overlap (128 x 8192 x 1536 = 1.61e9) to the native path.
+MAX_DIR_ELEMS = max_dir_elems(1)
 
 
 def _pick_tiles(W: int, Lq: int) -> Tuple[int, int]:
     """(tb, ch) for the band kernel: full 128 lanes, row tile shrunk
-    until the VMEM model fits (admission guarantees ch=8 fits)."""
-    for ch in (32, 8):
+    until the VMEM model fits (admission guarantees ch=4 fits; the ch=4
+    tier exists because the dual-column nxt plane's block doubled the
+    row-tile term and would otherwise evict the 8 kb genome geometry
+    that fit at ch=8 — see budget.vmem_est)."""
+    for ch in (32, 8, 4):
         if Lq % ch == 0 and _vmem_est(W, Lq, ch) <= _VMEM_BUDGET:
             return TB, ch
-    return TB, 8
+    return TB, 4
 
 
 def band_width_for_read(lq: int, lt: int) -> int:
@@ -102,29 +93,33 @@ def _chunk_breaking_points(q, t, lq, lt, t_begin, *, match, mismatch, gap,
     B = q.shape[0]
     klo, wl = band_geometry(lq, lt, W)
     PW = W + Lq
-    # Pre-shifted per-lane target window: tband[b, y] = t[b, klo_b + y].
-    tpad = jnp.concatenate(
-        [jnp.zeros((B, PW), jnp.uint8), t,
-         jnp.zeros((B, PW), jnp.uint8)], axis=1)
+    # Pre-shifted per-lane target window: tband[b, y] = t[b, klo_b + y],
+    # built from the FLATTENED target table via the shared i32-packed
+    # batched dynamic_slice (4 cells per descriptor word). A slice may
+    # spill into the neighbouring lane's row where the old per-row
+    # padded build read zeros — every such byte is out of [0, lt) and
+    # the okb mask overwrites it, so tband is bit-identical.
+    tab = jnp.concatenate(
+        [jnp.zeros((PW,), jnp.uint8), t.reshape(-1),
+         jnp.zeros((PW,), jnp.uint8)])
     y = jnp.arange(PW, dtype=jnp.int32)[None, :]
     rel = klo[:, None] + y
     okb = (rel >= 0) & (rel < lt[:, None])
-    sl = jax.vmap(
-        lambda row, s: jax.lax.dynamic_slice(row, (s,), (PW,)))(
-        tpad, klo + PW)
+    start = jnp.arange(B, dtype=jnp.int32) * LA + klo + PW
+    sl = _packed_byte_slice(tab, start, PW)
     tband = jnp.where(okb, sl, 7).astype(jnp.uint8)
 
     if pallas:
         tb, ch = _pick_tiles(W, Lq)
-        dirs, hlast = fw_dirs_band(
+        dirs, nxt, hlast = fw_dirs_band(
             tband, q.T, klo, lq, match=match, mismatch=mismatch, gap=gap,
             W=W, tb=tb, ch=ch)
     else:
-        dirs, hlast = fw_dirs_band_xla(
+        dirs, nxt, hlast = fw_dirs_band_xla(
             tband, q.T, klo, lq, match=match, mismatch=mismatch, gap=gap,
             W=W)
     cols = col_walk(dirs, lq, lt, klo, jnp.zeros(B, jnp.int32), LA=LA,
-                    layout="band_t" if pallas else "band")
+                    layout="band_t" if pallas else "band", nxt=nxt)
 
     # Tightened escape bound (same derivation as device_poa._round_core).
     xend = jnp.clip(lt - lq - klo, 0, W - 1)
@@ -181,7 +176,7 @@ def device_breaking_points(pending, sequences, window_length: int, *,
         W = _round_up(band_width_for_read(lq, lt), 512)
         lqp = _round_up(lq, 2048)
         if (TB * lqp * W > MAX_DIR_ELEMS or
-                _vmem_est(W, lqp, 8) > _VMEM_BUDGET or
+                _vmem_est(W, lqp, 4) > _VMEM_BUDGET or
                 max(lq, lt) >= 2 ** 14):   # int16 walk emissions
             fallback.append(o)
             continue
@@ -218,7 +213,7 @@ def device_breaking_points(pending, sequences, window_length: int, *,
         tLA = max(LA, _round_up(len(tc), 2048))
         tW = max(W, _round_up(band_width_for_read(len(qc), len(tc)), 512))
         if cur and (TB * tLq * tW > MAX_DIR_ELEMS or
-                    _vmem_est(tW, tLq, 8) > _VMEM_BUDGET):
+                    _vmem_est(tW, tLq, 4) > _VMEM_BUDGET):
             buckets.append((cur, Lq, LA, W))
             cur = []
             tLq = _round_up(len(qc), 2048)

@@ -47,6 +47,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from racon_tpu.ops.pallas.compat import CompilerParams as _CompilerParams
+
 from racon_tpu.ops.cigar import DIAG, UP, LEFT
 
 _NEG = -(2 ** 30)
@@ -78,8 +80,8 @@ def _score_dtype(match: int, mismatch: int, gap: int, Lq: int, W: int):
     return jnp.int32
 
 
-def _kernel(tbandT_ref, qT_ref, klo_ref, lq_ref, dirs_ref, hlast_ref,
-            prev_ref, ucprev_ref, *, match, mismatch, gap, W,
+def _kernel(tbandT_ref, qT_ref, klo_ref, lq_ref, dirs_ref, nxt_ref,
+            hlast_ref, prev_ref, ucprev_ref, *, match, mismatch, gap, W,
             dtype, TB, CH):
     # Transposed layout: band slots x on SUBLANES, jobs on LANES. The
     # per-row moving target window is then a dynamic *sublane* slice
@@ -102,10 +104,12 @@ def _kernel(tbandT_ref, qT_ref, klo_ref, lq_ref, dirs_ref, hlast_ref,
         # UP-chain metadata boundary (row 0): no UP can start above row 1,
         # and a chain that reaches row 0 is consumed by the forced LEFT
         # walk along the top row — encode that as consumer dir LEFT.
-        # U and C share one packed scratch (U << 2 | C): a long-read
-        # overlap chunk's VMEM budget is tight (ovl_align), and a
-        # separate C buffer costs another (W, TB) i32 block.
-        ucprev_ref[:] = jnp.full((W, TB), LEFT, jnp.int32)
+        # N, U and C share one packed scratch (N << 6 | U << 2 | C): a
+        # long-read overlap chunk's VMEM budget is tight (ovl_align), and
+        # separate buffers cost another (W, TB) i32 block each. Row-0 N
+        # is (U=0, C=LEFT) — the walk's forced top-row values — matching
+        # what a reader at row 0 would be forced to anyway.
+        ucprev_ref[:] = jnp.full((W, TB), (LEFT << 6) | LEFT, jnp.int32)
 
     def row(r, _):
         i = c * CH + r + 1                 # 1-based global row
@@ -155,13 +159,32 @@ def _kernel(tbandT_ref, qT_ref, klo_ref, lq_ref, dirs_ref, hlast_ref,
         # lanes are re-polished on the host path), C carries the chain
         # top's consumer direction down the chain.
         isup = d == UP
+        ucp = ucprev_ref[:]
         ucup = jnp.concatenate(
-            [ucprev_ref[1:, :], jnp.full((1, TB), LEFT, jnp.int32)],
+            [ucp[1:, :], jnp.full((1, TB), (LEFT << 6) | LEFT, jnp.int32)],
             axis=0)
-        U = jnp.where(isup, jnp.minimum((ucup >> 2) + 1, U_SAT), 0)
+        U = jnp.where(isup, jnp.minimum(((ucup >> 2) & 0xF) + 1, U_SAT), 0)
         C = jnp.where(isup, ucup & 3, d)
+        # Dual-column metadata (the second output plane): N = the packed
+        # (U' << 2 | C') of the PREDECESSOR cell the walk visits after
+        # undoing this cell's [UP run][consumer] block — cell
+        # (i - U - (C==DIAG), j - 1). One gather then undoes TWO target
+        # columns (docs/KERNELS.md). Propagation is three static shifts:
+        #   UP:   inherit from the cell above (same predecessor — the
+        #         whole chain shares its chain top's undo target),
+        #   DIAG: predecessor is (i-1, j-1) = prev row, same slot,
+        #   LEFT: predecessor is (i, j-1) = this row, slot x-1 (U and C
+        #         are finalized for the whole row before this select).
+        # Slot-0 LEFT reads a boundary fill — out-of-band predecessors
+        # only occur on paths that fail the escape bound (host redo).
+        ucnow = (U << 2) + C
+        nleft = jnp.concatenate(
+            [jnp.full((1, TB), LEFT, jnp.int32), ucnow[:-1, :]], axis=0)
+        N = jnp.where(isup, ucup >> 6,
+                      jnp.where(d == DIAG, ucp & 0x3F, nleft))
         dirs_ref[r] = (d + (C << 2) + (U << 4)).astype(jnp.uint8)
-        ucprev_ref[:] = (U << 2) + C
+        nxt_ref[r] = N.astype(jnp.uint8)
+        ucprev_ref[:] = (N << 6) + ucnow
         prev_ref[:] = h
         # Capture each lane's true final row as the row counter passes it.
         hlast_ref[:] = jnp.where((lqv == i)[None, :], h, hlast_ref[:])
@@ -172,11 +195,12 @@ def _kernel(tbandT_ref, qT_ref, klo_ref, lq_ref, dirs_ref, hlast_ref,
 
 @functools.partial(jax.jit,
                    static_argnames=("match", "mismatch", "gap", "W",
-                                    "tb", "ch"))
+                                    "tb", "ch", "interpret"))
 def fw_dirs_band(tband: jnp.ndarray, qT: jnp.ndarray, klo: jnp.ndarray,
                  lq: jnp.ndarray, *, match: int, mismatch: int, gap: int,
-                 W: int, tb: int = TB, ch: int = CH):
-    """Banded packed-cell tensor + final-row scores (Pallas, transposed).
+                 W: int, tb: int = TB, ch: int = CH,
+                 interpret: bool = False):
+    """Banded packed-cell tensors + final-row scores (Pallas, transposed).
 
     Args:
       tband: int32[B, W + Lq] pre-shifted targets (see module docstring).
@@ -184,24 +208,31 @@ def fw_dirs_band(tband: jnp.ndarray, qT: jnp.ndarray, klo: jnp.ndarray,
       klo:   int32[B] per-lane band origin.
       lq:    int32[B] per-lane query lengths (for final-row capture).
 
-    Returns (cells uint8[Lq, W, B], hlast int32[B, W]) — note cells has
-    band slots *before* jobs (kernel layout); fw_traceback_band takes
-    ``transposed=True`` for it. hlast[b, x] = H[lq_b][lq_b + klo_b + x].
-    Each cell byte packs ``dir | consumer_dir << 2 | up_run << 4`` (see
-    racon_tpu/ops/colwalk.py for the traceback that consumes it; the
-    plain direction is the low 2 bits). B % tb == 0, Lq % ch == 0
-    required. ``tb``/``ch`` tile the lane/row grid: the defaults suit
+    Returns (cells uint8[Lq, W, B], nxt uint8[Lq, W, B],
+    hlast int32[B, W]) — note cells/nxt have band slots *before* jobs
+    (kernel layout); fw_traceback_band takes ``transposed=True`` for it.
+    hlast[b, x] = H[lq_b][lq_b + klo_b + x].
+    Each cell byte packs ``dir | consumer_dir << 2 | up_run << 4``; the
+    matching ``nxt`` byte packs the predecessor cell's
+    ``consumer_dir | up_run << 2`` so one traceback gather undoes TWO
+    target columns (see racon_tpu/ops/colwalk.py for the walk and
+    docs/KERNELS.md for the contract; the plain direction is the low 2
+    bits of the cell byte). B % tb == 0, Lq % ch == 0 required.
+    ``tb``/``ch`` tile the lane/row grid: the defaults suit
     consensus-window shapes; long-read overlap alignment (W in the
     thousands, racon_tpu/ops/ovl_align.py) passes smaller tiles so the
     per-lane (W + Lq) target window plus scratch stays inside the
-    ~16 MiB VMEM budget (tb=128 at W=2176/Lq=5632 overflows by ~4 MiB).
+    ~16 MiB VMEM budget (racon_tpu/ops/budget.py::vmem_est).
+    ``interpret`` runs the kernel in Pallas interpreter mode so CPU
+    tier-1 tests exercise the exact kernel body (tests/
+    test_kernels_interpret.py).
     """
     B = tband.shape[0]
     Lq = qT.shape[0]
     dtype = _score_dtype(match, mismatch, gap, Lq, W)
     kernel = functools.partial(_kernel, match=match, mismatch=mismatch,
                                gap=gap, W=W, dtype=dtype, TB=tb, CH=ch)
-    dirs, hlast = pl.pallas_call(
+    dirs, nxt, hlast = pl.pallas_call(
         kernel,
         grid=(B // tb, Lq // ch),
         in_specs=[
@@ -217,20 +248,24 @@ def fw_dirs_band(tband: jnp.ndarray, qT: jnp.ndarray, klo: jnp.ndarray,
         out_specs=[
             pl.BlockSpec((ch, W, tb), lambda b, c: (c, 0, b),
                          memory_space=pltpu.VMEM),
+            pl.BlockSpec((ch, W, tb), lambda b, c: (c, 0, b),
+                         memory_space=pltpu.VMEM),
             pl.BlockSpec((W, tb), lambda b, c: (0, b),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((Lq, W, B), jnp.uint8),
+            jax.ShapeDtypeStruct((Lq, W, B), jnp.uint8),
             jax.ShapeDtypeStruct((W, B), dtype),
         ],
         scratch_shapes=[pltpu.VMEM((W, tb), dtype),
                         pltpu.VMEM((W, tb), jnp.int32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")),
+        interpret=interpret,
     )(tband.astype(jnp.int32).T, qT.astype(jnp.int32),
       klo[None, :], lq[None, :])
-    return dirs, hlast.T.astype(jnp.int32)
+    return dirs, nxt, hlast.T.astype(jnp.int32)
 
 
 @functools.partial(jax.jit,
@@ -253,9 +288,10 @@ def fw_dirs_band_xla(tband: jnp.ndarray, qT: jnp.ndarray, klo: jnp.ndarray,
     hl0 = P0
     U0 = jnp.zeros((B, W), jnp.int32)
     C0 = jnp.full((B, W), LEFT, jnp.int32)
+    N0 = jnp.full((B, W), LEFT, jnp.int32)
 
     def step(carry, inp):
-        P, hl, Up, Cp = carry
+        P, hl, Up, Cp, Np = carry
         i, qrow = inp
         tw = jax.lax.dynamic_slice_in_dim(t32, i - 1, W, axis=1)
         jcol = i + klo[:, None] + xr
@@ -286,16 +322,31 @@ def fw_dirs_band_xla(tband: jnp.ndarray, qT: jnp.ndarray, klo: jnp.ndarray,
             [Up[:, 1:], jnp.zeros((B, 1), jnp.int32)], axis=1)
         cup = jnp.concatenate(
             [Cp[:, 1:], jnp.full((B, 1), LEFT, jnp.int32)], axis=1)
+        nup = jnp.concatenate(
+            [Np[:, 1:], jnp.full((B, 1), LEFT, jnp.int32)], axis=1)
         U = jnp.where(isup, jnp.minimum(uup + 1, U_SAT), 0)
         C = jnp.where(isup, cup, d)
+        # Dual-column metadata — same three-shift propagation as the
+        # Pallas kernel (see _kernel): UP inherits from above, DIAG takes
+        # the previous row's same-slot (U, C), LEFT this row's slot x-1.
+        ucnow = (U << 2) + C
+        nleft = jnp.concatenate(
+            [jnp.full((B, 1), LEFT, jnp.int32), ucnow[:, :-1]], axis=1)
+        N = jnp.where(isup, nup,
+                      jnp.where(d == DIAG, (Up << 2) + Cp, nleft))
         packed = (d + (C << 2) + (U << 4)).astype(jnp.uint8)
         hl = jnp.where((lq == i)[:, None], h, hl)
-        return (h, hl, U, C), packed
+        # ONE stacked uint8 ys (not a tuple): a scan emitting a TUPLE of
+        # narrow-dtype ys miscompiles under XLA CPU jit in jax 0.9 (the
+        # reverse-scan int16 variant is the verified case, see
+        # racon_tpu/ops/colwalk.py) — don't gamble on the forward form.
+        return (h, hl, U, C, N), jnp.stack(
+            [packed, N.astype(jnp.uint8)], axis=0)
 
     ii = jnp.arange(1, Lq + 1, dtype=jnp.int32)
-    (_, hlast, _, _), dirs = jax.lax.scan(step, (P0, hl0, U0, C0),
-                                          (ii, qT.astype(jnp.int32)))
-    return dirs, hlast.astype(jnp.int32)
+    (_, hlast, _, _, _), ys = jax.lax.scan(step, (P0, hl0, U0, C0, N0),
+                                           (ii, qT.astype(jnp.int32)))
+    return ys[:, 0], ys[:, 1], hlast.astype(jnp.int32)
 
 
 def band_geometry(lq, lt, W: int):

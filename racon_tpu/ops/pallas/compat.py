@@ -1,0 +1,14 @@
+"""jax version compatibility for the Pallas TPU kernels.
+
+jax renamed ``pltpu.TPUCompilerParams`` to ``pltpu.CompilerParams``
+(jax 0.5 series); the kernels must import under either name so the
+interpreter-mode tier-1 tests (tests/test_kernels_interpret.py) can run
+them on CPU regardless of the installed jax. Resolve the name once here.
+"""
+
+from __future__ import annotations
+
+from jax.experimental.pallas import tpu as pltpu
+
+CompilerParams = getattr(pltpu, "CompilerParams", None) or \
+    pltpu.TPUCompilerParams

@@ -24,6 +24,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from racon_tpu.ops.pallas.compat import CompilerParams as _CompilerParams
+
 PB = 8     # p values per program (sublanes)
 TB = 128   # jobs per program (lanes)
 
@@ -64,7 +66,7 @@ def monotone_count_pallas(X: jnp.ndarray, P: int) -> jnp.ndarray:
         out_specs=pl.BlockSpec((PB, TB), lambda p, b: (p, b),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((Pp, B), jnp.int32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")),
     )(XT)
     return outT[:P].T

@@ -18,6 +18,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from racon_tpu.ops.pallas.compat import CompilerParams as _CompilerParams
+
 from racon_tpu.ops.cigar import DIAG, UP, LEFT
 
 _NEG = -(2 ** 30)
@@ -79,12 +81,16 @@ def _kernel(tbuf_ref, qT_ref, dirs_ref, prev_ref, uprev_ref, cprev_ref, *,
     jax.lax.fori_loop(0, CH, row, 0)
 
 
-@functools.partial(jax.jit, static_argnames=("match", "mismatch", "gap"))
+@functools.partial(jax.jit, static_argnames=("match", "mismatch", "gap",
+                                             "interpret"))
 def fw_dirs_pallas(tbuf: jnp.ndarray, qT: jnp.ndarray, *, match: int,
-                   mismatch: int, gap: int) -> jnp.ndarray:
+                   mismatch: int, gap: int,
+                   interpret: bool = False) -> jnp.ndarray:
     """Direction tensor uint8[Lq, B, Lt].
 
     B must be a multiple of TB (128), Lq of CH (32), Lt of 128.
+    ``interpret`` runs the kernel in Pallas interpreter mode so CPU
+    tier-1 tests exercise the exact kernel body.
     """
     B, Lt = tbuf.shape
     Lq = qT.shape[0]
@@ -105,6 +111,7 @@ def fw_dirs_pallas(tbuf: jnp.ndarray, qT: jnp.ndarray, *, match: int,
         scratch_shapes=[pltpu.VMEM((TB, Lt), jnp.int32),
                         pltpu.VMEM((TB, Lt), jnp.int32),
                         pltpu.VMEM((TB, Lt), jnp.int32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")),
+        interpret=interpret,
     )(tbuf.astype(jnp.int32), qT.astype(jnp.int32))

@@ -30,6 +30,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from racon_tpu.ops.pallas.compat import CompilerParams as _CompilerParams
+
 from racon_tpu.ops.cigar import DIAG, UP, LEFT
 
 _NEG = -(2 ** 30)
@@ -102,6 +104,6 @@ def nw_dirs_pallas(q: jnp.ndarray, t: jnp.ndarray, *, match: int,
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((Lq, B, Lt), jnp.uint8),
         scratch_shapes=[pltpu.VMEM((TB, Lt), jnp.int32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")),
     )(sub)

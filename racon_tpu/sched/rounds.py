@@ -175,11 +175,11 @@ def sched_unpack(job_buf, win_buf, *, Lq, LA, n_win):
     __import__("jax").jit,
     static_argnames=("match", "mismatch", "gap", "scale", "scale_final",
                      "Lq", "n_win", "LA", "pallas", "band_ws", "detect",
-                     "mesh"))
+                     "adaptive", "mesh"))
 def sched_rounds(bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf,
                  out_codes, out_cov, out_total, out_ovf, orig_ids, last, *,
                  match, mismatch, gap, scale, scale_final, Lq, n_win, LA,
-                 pallas, band_ws, detect, mesh=None):
+                 pallas, band_ws, detect, adaptive=False, mesh=None):
     """Run ``len(band_ws)`` refinement rounds in one dispatch, detect on
     the last of them, and scatter frozen windows' final-scale outputs.
 
@@ -190,19 +190,61 @@ def sched_rounds(bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf,
     executable. A window freezes when it converged, went overflow (its
     redo verdict cannot change — the flag is sticky in the fixed engine
     too), or the schedule ended.
+
+    ``adaptive`` (static; used by the scheduler's FUSED TAIL, where
+    every band width is the shared narrowed one and ``last`` is True):
+    runs the non-final rounds as a while_loop that exits once every
+    window is converged or overflowed, then the final round once.
+    Skipped rounds are exact replays for converged windows and discarded
+    work for overflowed ones — the frozen outputs are bit-identical to
+    the unrolled chain (the module docstring's replay argument applies
+    round by round). Returns the extra ``rounds_run`` int32 scalar
+    either way (== len(band_ws) when not adaptive).
     """
+    import jax
     import jax.numpy as jnp
 
     conv = jnp.zeros(n_win, dtype=bool)
-    for i, bw in enumerate(band_ws):
-        fn = _make_sched_fn(
+    if adaptive and len(band_ws) >= 2:
+        assert len(set(band_ws)) == 1 and not detect, \
+            "[racon_tpu::sched_rounds] adaptive tail requires uniform " \
+            "band widths and detection off (fused-tail call shape)"
+        fn_mid = _make_sched_fn(
             match=match, mismatch=mismatch, gap=gap, scale=scale,
             scale_final=scale_final, Lq=Lq, n_win=n_win, LA=LA,
-            pallas=pallas, band_w=bw,
-            detect=detect and i == len(band_ws) - 1, mesh=mesh)
+            pallas=pallas, band_w=band_ws[0], detect=True, mesh=mesh)
+
+        def cond(c):
+            return (c[0] < len(band_ws) - 1) & ~jnp.all(c[6] | c[7])
+
+        def body(c):
+            k, bb, bbw, alen, begin, end, conv, ovf = c
+            (bb, bbw, alen, begin, end, conv, ovf, _, _, _, _) = fn_mid(
+                bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf)
+            return (k + 1, bb, bbw, alen, begin, end, conv, ovf)
+
+        (k, bb, bbw, alen, begin, end, conv, ovf) = jax.lax.while_loop(
+            cond, body, (jnp.int32(0), bb, bbw, alen, begin, end, conv,
+                         ovf))
+        fn_last = _make_sched_fn(
+            match=match, mismatch=mismatch, gap=gap, scale=scale,
+            scale_final=scale_final, Lq=Lq, n_win=n_win, LA=LA,
+            pallas=pallas, band_w=band_ws[-1], detect=False, mesh=mesh)
         (bb, bbw, alen, begin, end, conv, ovf, ovf_f, codes_f, cov_f,
-         total_f) = fn(bb, bbw, alen, begin, end, q, qw8, lq, w_read,
-                       win, ovf)
+         total_f) = fn_last(bb, bbw, alen, begin, end, q, qw8, lq,
+                            w_read, win, ovf)
+        rounds_run = k + 1
+    else:
+        for i, bw in enumerate(band_ws):
+            fn = _make_sched_fn(
+                match=match, mismatch=mismatch, gap=gap, scale=scale,
+                scale_final=scale_final, Lq=Lq, n_win=n_win, LA=LA,
+                pallas=pallas, band_w=bw,
+                detect=detect and i == len(band_ws) - 1, mesh=mesh)
+            (bb, bbw, alen, begin, end, conv, ovf, ovf_f, codes_f, cov_f,
+             total_f) = fn(bb, bbw, alen, begin, end, q, qw8, lq, w_read,
+                           win, ovf)
+        rounds_run = jnp.int32(len(band_ws))
     freeze = conv | ovf | last
     trash = out_codes.shape[0] - 1
     sel = jnp.where(freeze, orig_ids, trash)
@@ -218,7 +260,7 @@ def sched_rounds(bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf,
     out_ovf = out_ovf.at[sel].set(
         jnp.where(last, ovf_f, ovf | (total_f > LA)))
     return (bb, bbw, alen, begin, end, ovf, conv,
-            out_codes, out_cov, out_total, out_ovf)
+            out_codes, out_cov, out_total, out_ovf, rounds_run)
 
 
 @functools.partial(__import__("jax").jit, static_argnames=("mesh",))
@@ -267,10 +309,13 @@ def sched_repack(bb, bbw, alen, begin, end, q, qw8, lq, w_read, ovf,
 
 
 @__import__("jax").jit
-def sched_pack(out_codes, out_cov, out_total, out_ovf):
+def sched_pack(out_codes, out_cov, out_total, out_ovf, rounds_exec,
+               rounds_sched):
     """Pack the output accumulators (trash row dropped) into the SAME
     d2h byte layout as the fixed engine (device_poa._pack_body), so
-    collect_chunk unpacks scheduler output unchanged."""
+    collect_chunk unpacks scheduler output unchanged. ``rounds_exec`` /
+    ``rounds_sched`` are the chunk's executed vs scheduled round counts
+    (the scheduler sums its dispatches' ``rounds_run``)."""
     from racon_tpu.ops.device_poa import _pack_body
     return _pack_body(out_codes[:-1], out_cov[:-1], out_total[:-1],
-                      out_ovf[:-1])
+                      out_ovf[:-1], rounds_exec, rounds_sched)

@@ -149,7 +149,7 @@ class ConvergenceScheduler:
         with tracer.span("round", f"rounds0-{pre - 1}", lanes=plan.B,
                          windows=n_real):
             (bb, bbw, alen, begin, end, ovf, conv, out_codes, out_cov,
-             out_total, out_ovf) = sched_rounds(
+             out_total, out_ovf, rounds_run) = sched_rounds(
                 bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf,
                 out_codes, out_cov, out_total, out_ovf, orig_ids, pre == R,
                 n_win=plan.n_win, pallas=pallas,
@@ -157,6 +157,7 @@ class ConvergenceScheduler:
                               for r in range(pre)),
                 detect=R >= 2, **statics)
         reg.inc("device_dispatches")
+        exec_dev = rounds_run        # device scalar; pulled via sched_pack
         executed = pre
 
         n_alive = n_real
@@ -202,18 +203,29 @@ class ConvergenceScheduler:
             if B2 >= cur_B and 2 * nw2 > cur_nwin:
                 for r in range(executed, R):
                     telem.record_round(r, n_alive)
+                tail_ws = tuple(round_band_width(band_w, r)
+                                for r in range(executed, R))
+                # The fused tail runs the remaining rounds blind (no
+                # per-round flag pull); the adaptive while_loop form
+                # stops its device loop at the chunk's fixed point
+                # instead of always running all R - executed rounds.
+                adapt = (os.environ.get("RACON_TPU_ADAPTIVE", "")
+                         not in ("0", "false")
+                         and len(tail_ws) >= 2
+                         and len(set(tail_ws)) == 1)
                 with tracer.span("round", f"rounds{executed}-{R - 1}",
                                  lanes=cur_B, windows=n_alive,
                                  fused_tail=1):
                     (bb, bbw, alen, begin, end, ovf, conv, out_codes,
-                     out_cov, out_total, out_ovf) = sched_rounds(
+                     out_cov, out_total, out_ovf, rounds_run) = \
+                        sched_rounds(
                         bb, bbw, alen, begin, end, q, qw8, lq, w_read,
                         win, ovf, out_codes, out_cov, out_total, out_ovf,
                         orig_ids, True, n_win=cur_nwin, pallas=pallas,
-                        band_ws=tuple(round_band_width(band_w, r)
-                                      for r in range(executed, R)),
-                        detect=False, **statics)
+                        band_ws=tail_ws, detect=False, adaptive=adapt,
+                        **statics)
                 reg.inc("device_dispatches")
+                exec_dev = exec_dev + rounds_run
                 executed = R
                 break
 
@@ -262,20 +274,22 @@ class ConvergenceScheduler:
             with tracer.span("round", f"round{executed}", lanes=rp.B,
                              windows=n_alive):
                 (bb, bbw, alen, begin, end, ovf, conv, out_codes, out_cov,
-                 out_total, out_ovf) = sched_rounds(
+                 out_total, out_ovf, rounds_run) = sched_rounds(
                     bb, bbw, alen, begin, end, q, qw8, lq, w_read, win,
                     ovf, out_codes, out_cov, out_total, out_ovf, orig_ids,
                     executed == R - 1, n_win=rp.n_win, pallas=pallas,
                     band_ws=(round_band_width(band_w, executed),),
                     detect=True, **statics)
             reg.inc("device_dispatches")
+            exec_dev = exec_dev + rounds_run
             executed += 1
 
         if n_alive > 0:
             # Whoever was still live froze on the schedule's last round.
             telem.record_freeze(R, n_alive)
 
-        packed = sched_pack(out_codes, out_cov, out_total, out_ovf)
+        packed = sched_pack(out_codes, out_cov, out_total, out_ovf,
+                            exec_dev, R)
         reg.inc("device_dispatches")
         if stats is not None:
             stats["chunks"] = stats.get("chunks", 0) + 1

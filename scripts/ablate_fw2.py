@@ -66,16 +66,16 @@ def main():
 
     @jax.jit
     def kern(tband):
-        dirs, hlast = fw_dirs_band(tband, qT, klo, lq, match=M, mismatch=X,
-                                   gap=G, W=W)
+        dirs, nxt, hlast = fw_dirs_band(tband, qT, klo, lq, match=M,
+                                        mismatch=X, gap=G, W=W)
         return jnp.sum(hlast) + jnp.sum(dirs[0, 0].astype(jnp.int32))
 
     print(f"kernel      : {timeit(kern, tband) * 1e3:7.1f} ms", flush=True)
 
     @jax.jit
     def kern_tb(tband):
-        dirs, hlast = fw_dirs_band(tband, qT, klo, lq, match=M, mismatch=X,
-                                   gap=G, W=W)
+        dirs, nxt, hlast = fw_dirs_band(tband, qT, klo, lq, match=M,
+                                        mismatch=X, gap=G, W=W)
         rev = fw_traceback_band(dirs, lq, lt, klo, steps, transposed=True)
         return jnp.sum(rev, dtype=jnp.int32) + jnp.sum(hlast)
 
@@ -83,8 +83,8 @@ def main():
 
     @jax.jit
     def kern_tb_flip(tband):
-        dirs, hlast = fw_dirs_band(tband, qT, klo, lq, match=M, mismatch=X,
-                                   gap=G, W=W)
+        dirs, nxt, hlast = fw_dirs_band(tband, qT, klo, lq, match=M,
+                                        mismatch=X, gap=G, W=W)
         rev = fw_traceback_band(dirs, lq, lt, klo, steps, transposed=True)
         ops = jnp.flip(rev, axis=1)
         return jnp.sum(ops[:, 0], dtype=jnp.int32) + jnp.sum(hlast)
@@ -94,8 +94,8 @@ def main():
 
     @jax.jit
     def kern_sum(tband):
-        dirs, hlast = fw_dirs_band(tband, qT, klo, lq, match=M, mismatch=X,
-                                   gap=G, W=W)
+        dirs, nxt, hlast = fw_dirs_band(tband, qT, klo, lq, match=M,
+                                        mismatch=X, gap=G, W=W)
         return jnp.sum(dirs, dtype=jnp.int32) + jnp.sum(hlast)
 
     print(f"kernel+sumd : {timeit(kern_sum, tband) * 1e3:7.1f} ms",

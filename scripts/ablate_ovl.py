@@ -71,16 +71,17 @@ def main():
         if upto == "tband":
             return jnp.sum(tband[:, ::64].astype(jnp.int32))
         if pallas:
-            dirs, hlast = fw_dirs_band(
+            dirs, nxt, hlast = fw_dirs_band(
                 tband, q.T, klo, lq, match=0, mismatch=-1, gap=-1,
                 W=W, tb=tb, ch=ch)
         else:
-            dirs, hlast = fw_dirs_band_xla(
+            dirs, nxt, hlast = fw_dirs_band_xla(
                 tband, q.T, klo, lq, match=0, mismatch=-1, gap=-1, W=W)
         if upto == "fw":
             return jnp.sum(dirs[0, 0].astype(jnp.int32)) + jnp.sum(hlast)
         cols = col_walk(dirs, lq, lt, klo, jnp.zeros(B, jnp.int32),
-                        LA=LA, layout="band_t" if pallas else "band")
+                        LA=LA, layout="band_t" if pallas else "band",
+                        nxt=nxt)
         if upto == "walk":
             return sum(jnp.sum(cols[k].astype(jnp.int32))
                        for k in ("ins_len", "op_c", "qi_c"))

@@ -1,0 +1,99 @@
+"""Ablation: traceback dependency-chain length, single vs dual walk.
+
+The column walk's cost on TPU is its serialized per-column HBM gather
+chain (PROFILE.md round 5's top remaining compute cost). The dual-
+column walk consumes the band kernels' nxt plane to undo TWO anchor
+positions per dependent gather, halving the chain:
+
+  single : LA + 2 columns -> 1 dependent gather per column
+  dual   : LA + 2 columns -> 1 dependent gather per 2 columns
+
+Runs the band forward (XLA twin, any backend) once per Lq, then times
+col_walk with and without the nxt plane and checks bit-identity of the
+unflagged-lane channels — the ratio isolates lever 1 of round 6 from
+kernel cost.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def t(fn, *args, reps=10):
+    """Chained dispatch, single trailing sync (PROFILE.md timing rule)."""
+    out = fn(*args)
+    np.asarray(out["sat"])                     # compile + settle
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    np.asarray(out["sat"])
+    return (time.perf_counter() - t0) / reps
+
+
+def _inputs(rng, B, Lq, W):
+    """Vectorized random band jobs (no per-cell python loops)."""
+    import jax.numpy as jnp
+    from racon_tpu.ops.pallas.band_kernel import band_geometry
+
+    lq = rng.integers(Lq // 2, Lq + 1, B).astype(np.int32)
+    lt = (lq + rng.integers(-Lq // 16, Lq // 16 + 1, B)).clip(8)
+    lt = lt.astype(np.int32)
+    qT = rng.integers(0, 4, (Lq, B)).astype(np.uint8)
+    klo, _ = band_geometry(jnp.asarray(lq), jnp.asarray(lt), W)
+    klo_h = np.asarray(klo)
+    ts = rng.integers(0, 4, (B, int(lt.max()))).astype(np.uint8)
+    j = klo_h[:, None] + np.arange(W + Lq)[None, :]
+    tband = np.where((j >= 0) & (j < lt[:, None]),
+                     np.take_along_axis(ts, j.clip(0, ts.shape[1] - 1),
+                                        axis=1),
+                     np.uint8(7)).astype(np.uint8)
+    return tband, qT, klo, lq, lt
+
+
+def main():
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from racon_tpu.ops.colwalk import col_walk
+    from racon_tpu.ops.pallas.band_kernel import fw_dirs_band_xla
+
+    B, W = 1024, 128
+    rng = np.random.default_rng(0)
+    print(f"backend={jax.default_backend()}  B={B} W={W}")
+    print(f"{'Lq':>6} {'chain_s':>8} {'chain_d':>8} "
+          f"{'single_ms':>10} {'dual_ms':>8} {'speedup':>8} {'bitid':>6}")
+    for Lq in (128, 256, 512, 1024):
+        tband, qT, klo, lq, lt = _inputs(rng, B, Lq, W)
+        dirs, nxt, _ = fw_dirs_band_xla(
+            jnp.asarray(tband), jnp.asarray(qT), klo, jnp.asarray(lq),
+            match=5, mismatch=-4, gap=-8, W=W)
+        LA = tband.shape[1] + 16
+        t_off = jnp.zeros(B, jnp.int32)
+        args = (dirs, jnp.asarray(lq), jnp.asarray(lt), klo, t_off)
+        single = jax.jit(functools.partial(col_walk, LA=LA, layout="band"))
+        dual = jax.jit(functools.partial(col_walk, LA=LA, layout="band",
+                                         nxt=nxt))
+        ts_ = t(single, *args)
+        td_ = t(dual, *args)
+        s, d = single(*args), dual(*args)
+        ok = ~np.asarray(s["sat"])
+        bitid = (np.array_equal(np.asarray(s["sat"]),
+                                np.asarray(d["sat"])) and
+                 all(np.array_equal(np.asarray(s[k])[ok],
+                                    np.asarray(d[k])[ok])
+                     for k in ("ins_len", "qstart", "op_c", "qi_c")))
+        print(f"{Lq:>6} {LA + 2:>8} {(LA + 2 + 1) // 2:>8} "
+              f"{ts_ * 1e3:>10.2f} {td_ * 1e3:>8.2f} "
+              f"{ts_ / td_:>7.2f}x {'PASS' if bitid else 'FAIL':>6}")
+        if not bitid:
+            sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -88,13 +88,14 @@ def main():
             if upto == "tband":
                 return jnp.sum(tband[:, 0], dtype=jnp.int32)
             fwd = fw_dirs_band if pallas else fw_dirs_band_xla
-            dirs, hlast = fwd(tband, q.T, klo, lqv, match=M, mismatch=X,
-                              gap=G, W=band_w)
+            dirs, nxt, hlast = fwd(tband, q.T, klo, lqv, match=M,
+                                   mismatch=X, gap=G, W=band_w)
             if upto == "fw":
                 return (jnp.sum(dirs[0, 0].astype(jnp.int32)) +
                         jnp.sum(hlast))
             cols = col_walk(dirs, lqv, lt, klo, t_off, LA=LA,
-                            layout="band_t" if pallas else "band")
+                            layout="band_t" if pallas else "band",
+                            nxt=nxt)
         else:
             x = jnp.arange(LA, dtype=jnp.int32)[None, :]
             ok = x < lt[:, None]

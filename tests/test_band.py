@@ -62,7 +62,7 @@ def test_band_scores_and_paths_match_oracle(scoring):
             j = klo_h[b] + y
             if 0 <= j < lt[b]:
                 tband[b, y] = ts[b][j]
-    dirs, hlast = fw_dirs_band_xla(
+    dirs, _, hlast = fw_dirs_band_xla(
         jnp.asarray(tband), jnp.asarray(qpad.T), klo,
         jnp.asarray(lq), match=m, mismatch=x, gap=g, W=W)
     rev = fw_traceback_band(dirs, jnp.asarray(lq), jnp.asarray(lt), klo,

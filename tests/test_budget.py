@@ -1,0 +1,76 @@
+"""Shared element/VMEM budget (ops/budget.py): boundary geometries.
+
+Round 5's bug class: the consensus engine capped dirs planes at a
+hand-written 1.6e9 while the overlap aligner re-derived 1.9e9, and the
+0.7% gap silently routed every 8 kb genome overlap (128 x 8192 x 1536
+= 1.61e9 elements) to the native fallback. These tests pin (a) the two
+consumers import the SAME derived cap, (b) the cap's boundary admits
+the genome geometry and rejects what the constraints forbid, (c) the
+VMEM tile picker still admits the genome geometry now that the
+dual-column nxt plane doubled the row-tile term.
+"""
+
+import numpy as np
+
+from racon_tpu.ops import budget
+from racon_tpu.ops import device_poa
+from racon_tpu.ops import ovl_align
+
+# The geometry the round-5 literal wrongly rejected: 128 lanes of 8 kb
+# reads at the W=1536 long-read band.
+GENOME_ELEMS = 128 * 8192 * 1536            # 1,610,612,736
+
+
+def test_consumers_share_one_cap():
+    assert device_poa.MAX_DIR_ELEMS == budget.max_dir_elems(1)
+    assert ovl_align.MAX_DIR_ELEMS == budget.max_dir_elems(1)
+
+
+def test_u8_cap_admits_genome_geometry():
+    cap = budget.max_dir_elems(1)
+    assert cap == 1_932_735_283
+    assert GENOME_ELEMS <= cap
+    # ~2.2e9 violates both the int32 flat index and the 2 GB buffer.
+    assert 128 * 8192 * 2176 > cap
+
+
+def test_cap_never_exceeds_hard_constraints():
+    for cb in (1, 2, 4):
+        cap = budget.max_dir_elems(cb)
+        assert cap < budget.INT32_INDEX_ELEMS
+        assert cap * cb < budget.BUFFER_BYTES
+
+
+def test_u16_cells_would_reject_genome_geometry():
+    # Why the dual-column metadata ships as a second u8 plane and not a
+    # widened u16 cell word: the 2 GB buffer ceiling halves the cap.
+    assert budget.max_dir_elems(2) == 966_367_641
+    assert GENOME_ELEMS > budget.max_dir_elems(2)
+
+
+def test_pick_tiles_admits_genome_geometry_at_ch4():
+    # The nxt plane doubled vmem_est's row-tile dirs term; without the
+    # ch=4 tier the 8 kb genome tile (W=1536, Lq=8192) that fit at ch=8
+    # would be evicted from VMEM admission.
+    W, Lq = 1536, 8192
+    assert budget.vmem_est(W, Lq, 8) > budget.VMEM_BUDGET
+    assert budget.vmem_est(W, Lq, 4) <= budget.VMEM_BUDGET
+    tb, ch = ovl_align._pick_tiles(W, Lq)
+    assert (tb, ch) == (ovl_align.TB, 4)
+    assert ovl_align.TB * Lq * W <= ovl_align.MAX_DIR_ELEMS
+
+
+def test_vmem_model_monotone_in_ch():
+    for W, Lq in ((128, 256), (768, 4096), (1536, 8192)):
+        ests = [budget.vmem_est(W, Lq, ch) for ch in (4, 8, 32)]
+        assert ests == sorted(ests)
+        assert all(e > 0 for e in ests)
+
+
+def test_cell_bytes_validation():
+    try:
+        budget.max_dir_elems(0)
+    except ValueError:
+        pass
+    else:
+        raise AssertionError("cell_bytes=0 must be rejected")

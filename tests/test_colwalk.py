@@ -108,9 +108,9 @@ def test_colwalk_matches_legacy_band():
             j = klo_h[b] + y
             if 0 <= j < lt[b]:
                 tband[b, y] = ts[b][j]
-    dirs, _ = fw_dirs_band_xla(jnp.asarray(tband), jnp.asarray(qT), klo,
-                               jnp.asarray(lq), match=M, mismatch=X,
-                               gap=G, W=W)
+    dirs, nxt, _ = fw_dirs_band_xla(jnp.asarray(tband), jnp.asarray(qT),
+                                    klo, jnp.asarray(lq), match=M,
+                                    mismatch=X, gap=G, W=W)
     rev = fw_traceback_band(dirs, jnp.asarray(lq), jnp.asarray(lt), klo,
                             Lq + W)
     ops = jnp.flip(rev, axis=1)
@@ -128,6 +128,97 @@ def test_colwalk_matches_legacy_band():
                                 jnp.asarray(w_read), jnp.asarray(lt),
                                 jnp.asarray(t_off), LA)
     _votes_equal(old, new)
+
+
+def _band_case(rng, B, err):
+    """Random banded jobs -> (dirs, nxt, lq, lt, klo, LA)."""
+    qs, ts = _random_jobs(rng, B, err=err)
+    tbuf, qT, lq, lt = _pad(qs, ts)
+    W = 128
+    LA = tbuf.shape[1] + 16
+    klo, _ = band_geometry(jnp.asarray(lq), jnp.asarray(lt), W)
+    klo_h = np.asarray(klo)
+    tband = np.full((tbuf.shape[0], W + qT.shape[0]), 7, np.uint8)
+    for b in range(tbuf.shape[0]):
+        for y in range(tband.shape[1]):
+            j = klo_h[b] + y
+            if 0 <= j < lt[b]:
+                tband[b, y] = ts[b][j]
+    dirs, nxt, _ = fw_dirs_band_xla(jnp.asarray(tband), jnp.asarray(qT),
+                                    klo, jnp.asarray(lq), match=M,
+                                    mismatch=X, gap=G, W=W)
+    return dirs, nxt, lq, lt, klo, LA
+
+
+@pytest.mark.parametrize("seed,err", [(21, 0.1), (22, 0.2), (23, 0.35)])
+def test_dual_walk_matches_single_walk(seed, err):
+    """Property: the dual-column walk (nxt plane, two positions per
+    dependent gather) is bit-identical to the single-column reference
+    walk on randomized alignments — every channel, every lane the
+    saturation certificate admits; the sat flags themselves must agree
+    ALWAYS (flagged windows re-polish on the host in both modes, so flag
+    equality is the whole bit-identity contract for them)."""
+    rng = np.random.default_rng(seed)
+    dirs, nxt, lq, lt, klo, LA = _band_case(rng, 15, err)
+    B = lq.shape[0]
+    t_off = rng.integers(0, 9, B).astype(np.int32)
+    single = col_walk(dirs, jnp.asarray(lq), jnp.asarray(lt), klo,
+                      jnp.asarray(t_off), LA=LA, layout="band")
+    dual = col_walk(dirs, jnp.asarray(lq), jnp.asarray(lt), klo,
+                    jnp.asarray(t_off), LA=LA, layout="band", nxt=nxt)
+    sat = np.asarray(single["sat"])
+    assert np.array_equal(sat, np.asarray(dual["sat"]))
+    ok = ~sat
+    for k in ("ins_len", "qstart", "op_c", "qi_c"):
+        assert np.array_equal(np.asarray(single[k])[ok],
+                              np.asarray(dual[k])[ok]), k
+
+
+def test_packed_byte_encode_decode():
+    """Property: the walk's decode shifts invert the kernels' packing
+    for EVERY valid field combination.
+
+    dirs byte: d | consumer << 2 | up_run << 4 (d, consumer in 0..2,
+    up_run in 0..U_SAT). nxt byte: up_run' << 2 | consumer'. Kernel
+    scratch packs 12 bits (nxt << 6 | up_run << 2 | consumer) — the
+    up_run unpack there MUST mask & 0xF or the nxt bits alias into it
+    (the exact bug class this test pins)."""
+    for d in range(3):
+        for c in range(3):
+            for u in range(U_SAT + 1):
+                pv = d + (c << 2) + (u << 4)
+                assert pv < 256
+                assert (pv & 3) == d
+                assert ((pv >> 2) & 3) == c
+                assert (pv >> 4) == u
+                nv = (u << 2) + c
+                assert nv < 64          # fits the scratch's 6 nxt bits
+                assert (nv >> 2) == u and (nv & 3) == c
+                for n in range(64):
+                    sc = (n << 6) + (u << 2) + c
+                    assert (sc & 3) == c
+                    assert ((sc >> 2) & 0xF) == u
+                    assert (sc >> 6) == n
+
+
+def test_packed_byte_slice_matches_dynamic_slice():
+    """Property: device_poa._packed_byte_slice (i32-packed batched
+    dynamic_slice, 4 cells/word) equals the plain per-byte slice for
+    every start phase, including start = size - L (the 2-word slack
+    boundary)."""
+    from racon_tpu.ops.device_poa import _packed_byte_slice
+    rng = np.random.default_rng(31)
+    for _ in range(10):
+        L = int(rng.integers(4, 400))
+        n = int(rng.integers(L + 1, L + 3000))
+        tab = rng.integers(0, 256, n).astype(np.uint8)
+        start = rng.integers(0, n - L + 1, 16).astype(np.int32)
+        start[:4] = [0, 1, 2, 3]
+        start[4] = n - L
+        out = np.asarray(_packed_byte_slice(jnp.asarray(tab),
+                                            jnp.asarray(start), L))
+        ref = np.stack([tab[s:s + L] for s in start])
+        assert np.array_equal(out, ref), (n, L)
 
 
 def test_colwalk_leading_insertion_saturation():

@@ -1,0 +1,77 @@
+"""Pallas kernels in interpreter mode on CPU vs their XLA twins.
+
+The production Pallas kernels only run on TPU, so before this gate the
+CPU tier-1 suite exercised the XLA twins alone — a kernel-body bug
+(e.g. in the dual-column metadata shifts) would ship silently and only
+surface as a TPU-side differential failure. ``interpret=True`` runs the
+EXACT kernel body through the Pallas interpreter on CPU; these tests
+pin it bit-identical to the twins the rest of tier-1 certifies.
+
+Shapes honor the kernels' tiling contracts: band TB=128 lanes with
+Lq % 8 == 0, flat TB=128 / CH=32 / Lt % 128 == 0.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from racon_tpu.ops.flat import fw_dirs_xla
+from racon_tpu.ops.pallas.band_kernel import (band_geometry, fw_dirs_band,
+                                              fw_dirs_band_xla)
+from racon_tpu.ops.pallas.flat_kernel import fw_dirs_pallas
+
+M, X, G = 5, -4, -8
+
+
+def _band_inputs(rng, B=128, Lq=32, W=128):
+    lq = rng.integers(10, Lq + 1, B).astype(np.int32)
+    lt = (lq + rng.integers(-5, 6, B)).clip(5).astype(np.int32)
+    qT = rng.integers(0, 4, (Lq, B)).astype(np.uint8)
+    klo, _ = band_geometry(jnp.asarray(lq), jnp.asarray(lt), W)
+    klo_h = np.asarray(klo)
+    ts = rng.integers(0, 4, (B, int(lt.max()))).astype(np.uint8)
+    tband = np.full((B, W + Lq), 7, np.uint8)
+    for b in range(B):
+        for y in range(W + Lq):
+            j = klo_h[b] + y
+            if 0 <= j < lt[b]:
+                tband[b, y] = ts[b, j]
+    return tband, qT, klo, lq
+
+
+@pytest.mark.parametrize("scoring", [(M, X, G), (0, -1, -1)])
+def test_band_kernel_interpret_matches_xla_twin(scoring):
+    """fw_dirs_band(interpret=True) == fw_dirs_band_xla on all THREE
+    outputs — dirs (packed dir|consumer|up_run byte), nxt (dual-column
+    predecessor metadata plane), hlast — modulo the layout transpose."""
+    m, x, g = scoring
+    rng = np.random.default_rng(7)
+    tband, qT, klo, lq = _band_inputs(rng)
+    W = 128
+    di, ni, hi = fw_dirs_band(jnp.asarray(tband), jnp.asarray(qT), klo,
+                              jnp.asarray(lq), match=m, mismatch=x,
+                              gap=g, W=W, interpret=True)
+    dx, nx, hx = fw_dirs_band_xla(jnp.asarray(tband), jnp.asarray(qT),
+                                  klo, jnp.asarray(lq), match=m,
+                                  mismatch=x, gap=g, W=W)
+    # Pallas band layout is [Lq, W, B]; the twin's is [Lq, B, W].
+    assert np.array_equal(np.transpose(np.asarray(di), (0, 2, 1)),
+                          np.asarray(dx))
+    assert np.array_equal(np.transpose(np.asarray(ni), (0, 2, 1)),
+                          np.asarray(nx))
+    assert np.array_equal(np.asarray(hi), np.asarray(hx))
+
+
+def test_flat_kernel_interpret_matches_xla():
+    """fw_dirs_pallas(interpret=True) == flat.fw_dirs_xla bit-for-bit
+    (same [Lq, B, Lt] layout, packed byte included)."""
+    rng = np.random.default_rng(3)
+    B, Lq, Lt = 128, 32, 128
+    tbuf = rng.integers(0, 4, (B, Lt)).astype(np.uint8)
+    qT = rng.integers(0, 4, (Lq, B)).astype(np.uint8)
+    a = fw_dirs_pallas(jnp.asarray(tbuf), jnp.asarray(qT), match=M,
+                       mismatch=X, gap=G, interpret=True)
+    b = fw_dirs_xla(jnp.asarray(tbuf), jnp.asarray(qT), match=M,
+                    mismatch=X, gap=G)
+    assert np.array_equal(np.asarray(a), np.asarray(b))
