@@ -257,8 +257,18 @@ def main():
         if k.startswith("adaptive_")}
     extras = {**sched_extras, **e2e_transfers, **pipe_extras,
               **probe_extras, **adaptive_extras,
-              **cache_extras(), **obs_metrics.resilience_extras()}
+              **cache_extras(), **obs_metrics.resilience_extras(),
+              **obs_metrics.ovl_extras()}
     out = {
+        # metric_version 7: same primary value as versions 2-6 (the
+        # consensus bench runs no overlap alignment, so the compute
+        # rate is untouched). New in 7: the ovl_* extras ride along —
+        # ovl_device_jobs / ovl_native_jobs / ovl_tiles_exec /
+        # ovl_device_fraction from the tiled ultralong overlap path
+        # (ops/ovl_align.py round 7) and align_phase_seconds, the
+        # polisher's wall-clock alignment phase — all absent on a bench
+        # that never aligned overlaps, populated when the genome bench
+        # path runs a polish in-process.
         # metric_version 6: same primary value as versions 2-5
         # (compute-only windows/s of a warm production chunk). New in 6:
         # the e2e rate is the MEDIAN of RACON_TPU_BENCH_E2E_REPS reps
@@ -291,7 +301,7 @@ def main():
         # fixed_engine_windows_per_sec. Bump this whenever the primary
         # value's definition changes, so round-over-round comparisons
         # can't silently mix metrics.
-        "metric_version": 6,
+        "metric_version": 7,
         "metric": f"POA windows/sec/chip, compute-only (direct-timed warm "
                   f"production chunk, convergence-scheduled refinement "
                   f"rounds — racon_tpu/sched/, telemetry in sched_* "

@@ -44,6 +44,9 @@ python -m pytest tests/test_kernels_interpret.py tests/test_colwalk.py \
 echo "[ci] two-shape device-engine smoke"
 python scripts/two_shape_smoke.py
 
+echo "[ci] ultralong smoke (32 kb tiled device path, zero native fallbacks)"
+python scripts/ultralong_smoke.py
+
 echo "[ci] observability smoke (traced tiny polish + JSONL schema gate)"
 python scripts/obs_smoke.py
 

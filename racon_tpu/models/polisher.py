@@ -233,6 +233,9 @@ class Polisher:
         # fall back to the batched native call, which also serves the
         # CPU backend outright (src/polisher.cpp:351-364,
         # overlap.cpp:194-213).
+        import time as _time
+        from racon_tpu.obs import metrics as obs_metrics
+        t_align = _time.perf_counter()
         pending = [o for o in overlaps if len(o.cigar) == 0]
         if pending and self.engine.backend == "jax":
             from racon_tpu.ops.ovl_align import device_breaking_points
@@ -261,6 +264,11 @@ class Polisher:
             # 20-tick cap as in the reference (src/polisher.cpp:359-364).
             if step and (i + 1) % step == 0 and (i + 1) // step <= 20:
                 log.tick("[racon_tpu::Polisher::initialize] aligning overlaps")
+        # The whole phase — device dispatch, native fallback, and the
+        # breaking-point walk — is the 47 s align term of the 89 s 2 Mb
+        # genome run (PROFILE.md); bench extras track it per round as
+        # align_phase_seconds (metric_version 7).
+        obs_metrics.record_align_phase(_time.perf_counter() - t_align)
         log.phase("[racon_tpu::Polisher::initialize] aligned overlaps")
         log.begin()
 

@@ -234,6 +234,50 @@ def resilience_extras(reg: Optional[MetricsRegistry] = None
     return out
 
 
+# ------------------------------------------- overlap-alignment counters
+
+def record_ovl(device_jobs: int, native_jobs: int, tiles: int,
+               reg: Optional[MetricsRegistry] = None) -> None:
+    """Account one device_breaking_points batch (ops/ovl_align.py):
+    ``device_jobs`` overlaps whose breaking points the device produced
+    (untiled + tiled, minus uncertified), ``native_jobs`` overlaps
+    routed to the native aligner (over budget OR uncertified), and
+    ``tiles`` query-axis tiles executed by the tiled ultralong path.
+    ``ovl_device_fraction`` is the running device share — the headline
+    number for ROADMAP item 3 (it was pinned ~0 for ultralong inputs
+    before the tiled path existed)."""
+    reg = reg if reg is not None else _REGISTRY
+    reg.inc("ovl_device_jobs", int(device_jobs))
+    reg.inc("ovl_native_jobs", int(native_jobs))
+    reg.inc("ovl_tiles_exec", int(tiles))
+    total = reg.get("ovl_device_jobs") + reg.get("ovl_native_jobs")
+    if total > 0:
+        reg.set("ovl_device_fraction",
+                round(reg.get("ovl_device_jobs") / total, 4))
+
+
+def record_align_phase(seconds: float,
+                       reg: Optional[MetricsRegistry] = None) -> None:
+    """Wall seconds of one polisher align phase (device dispatch +
+    native fallback + breaking-point walk; models/polisher.py phase 5).
+    Accumulates across contigs so bench extras see the whole run."""
+    reg = reg if reg is not None else _REGISTRY
+    reg.inc("align_phase_seconds", float(seconds))
+
+
+def ovl_extras(reg: Optional[MetricsRegistry] = None
+               ) -> Dict[str, object]:
+    """The registry's ovl_* keys plus align_phase_seconds as a
+    JSON-ready dict (bench extras metric_version 7 / obs_report).
+    Empty when no overlap alignment ran."""
+    reg = reg if reg is not None else _REGISTRY
+    out: Dict[str, object] = {}
+    for k, v in sorted(reg.snapshot().items()):
+        if k.startswith("ovl_") or k == "align_phase_seconds":
+            out[k] = round(v, 4) if isinstance(v, float) else v
+    return out
+
+
 # ------------------------------------------------------ pipeline gauges
 
 def record_stage(name: str, busy_s: float, stall_in_s: float,

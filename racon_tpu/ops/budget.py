@@ -65,3 +65,95 @@ def vmem_est(W: int, Lq: int, ch: int) -> int:
     always pad to 128 on TPU, so shrinking the batch below 128 lanes
     saves nothing — ch and the admission cap are the only levers."""
     return 128 * (4 * (W + Lq) + W * (4 * ch + 16))
+
+
+# ---------------------------------------------------------------------------
+# Per-tile admission tiers for the TILED band forward (ultralong reads).
+#
+# The untiled overlap path admits a whole read only when
+# 128 * round_up(Lq) * W fits max_dir_elems(1) — which caps reads at
+# ~9 kb at the W=1024 overlap band. The tiled path runs the SAME band
+# kernel over query-axis tiles of T rows, carrying the DP frontier
+# between tiles, so the per-dispatch VMEM working set depends on
+# (W, T, ch) only. Two budgets remain read-length dependent:
+#
+#   * element cap  — the walk still addresses the STITCHED dirs/nxt
+#     tensors ([Lq, W, B]) through one flat int32 index, so
+#     B * round_up(Lq, T) * W <= max_dir_elems(1) must hold. Lanes (B)
+#     become the lever: fewer lanes per chunk buys longer reads.
+#   * VMEM         — vmem_est(W, T, ch) <= VMEM_BUDGET per tile, since
+#     the kernel's tband window block is (W + T) tall, not (W + Lq).
+#
+# Each tier is (lanes, W, T, ch), ordered preferred-first (more lanes
+# amortize dispatch better; wider bands certify more error). With the
+# 1.93e9 u8 cap the tiers admit reads up to:
+#
+#   (64, 1536, 2048, 4): vmem 7.75 MiB, Lq <= 19,660 -> 18 kb class
+#   (16, 2048, 2048, 4): vmem 10.0 MiB, Lq <= 58,982 -> 57 kb class
+#   ( 8, 2048, 4096, 4): vmem 11.0 MiB, Lq <= 117,964 -> 114 kb class
+#
+# covering the 50-100 kb ONT ultralong range that motivated the tiling
+# (ROADMAP item 3). tests/test_budget.py pins every tier against all
+# three budgets.
+# ---------------------------------------------------------------------------
+
+TILE_TIERS = (
+    (64, 1536, 2048, 4),
+    (16, 2048, 2048, 4),
+    (8, 2048, 4096, 4),
+)
+
+
+class TilePlan:
+    """Admission result for one tiled overlap job: chunk geometry plus
+    the padded query length / tile count the dispatch will use."""
+
+    __slots__ = ("lanes", "W", "T", "ch", "Lq", "n_tiles")
+
+    def __init__(self, lanes, W, T, ch, Lq, n_tiles):
+        self.lanes = lanes
+        self.W = W
+        self.T = T
+        self.ch = ch
+        self.Lq = Lq
+        self.n_tiles = n_tiles
+
+    def key(self):
+        return (self.lanes, self.W, self.T, self.ch)
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return ("TilePlan(lanes=%d, W=%d, T=%d, ch=%d, Lq=%d, n_tiles=%d)"
+                % (self.lanes, self.W, self.T, self.ch, self.Lq,
+                   self.n_tiles))
+
+
+def tile_plan(lq: int, lt: int, tiers=None):
+    """Pick the first tier that admits an (lq, lt) overlap job under all
+    three budgets, or None when no tier fits (caller falls back to the
+    native aligner).
+
+    Admission conditions per tier (lanes, W, T, ch):
+
+    * ``|lt - lq| <= W // 2`` — the banded recurrence needs the start
+      AND end corners inside every per-tile band; re-centering can only
+      track drift when the length imbalance leaves clearance on both
+      sides of the band.
+    * ``lanes * round_up(lq, T) * W <= max_dir_elems(1)`` — flat int32
+      walk index / 2 GB buffer over the stitched dirs (and nxt) plane.
+    * ``vmem_est(W, T, ch) <= VMEM_BUDGET`` — per-tile kernel blocks.
+    """
+    if tiers is None:
+        tiers = TILE_TIERS
+    lq = max(int(lq), 1)
+    lt = max(int(lt), 1)
+    cap = max_dir_elems(1)
+    for lanes, W, T, ch in tiers:
+        if abs(lt - lq) > W // 2:
+            continue
+        Lq = -(-lq // T) * T
+        if lanes * Lq * W > cap:
+            continue
+        if vmem_est(W, T, ch) > VMEM_BUDGET:
+            continue
+        return TilePlan(lanes, W, T, ch, Lq, Lq // T)
+    return None

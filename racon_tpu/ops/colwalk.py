@@ -49,13 +49,14 @@ from racon_tpu.ops.flat import PAD_OP, U_SAT
 
 
 def col_walk(cells, lq, lt, klo, t_off, *, LA: int, layout: str,
-             nxt=None):
+             nxt=None, tile_klo=None, tile_len: int = 0, emit=None):
     """Walk packed cells over the anchor-position grid.
 
     Args:
       cells: uint8 packed-cell tensor from a forward kernel.
       lq, lt: int32[B] per-lane query / target lengths.
-      klo: int32[B] band origin (band layouts) or None (flat).
+      klo: int32[B] band origin (band layouts) or None (flat / when
+        ``tile_klo`` supplies per-tile origins).
       t_off: int32[B] anchor offset of each lane's target slice.
       LA: static anchor padding length; the scan runs LA + 2 steps.
       layout: "band_t" [Lq, W, B] (Pallas band), "band" [Lq, B, W]
@@ -72,9 +73,23 @@ def col_walk(cells, lq, lt, klo, t_off, *, LA: int, layout: str,
         may emit differently but are re-polished on the host path in
         both modes (their ``sat``/escape flags themselves are
         identical).
+      tile_klo: optional int32[n_tiles, B] per-TILE band origins from
+        the tiled ultralong forward (ops/ovl_align.py): stored row r
+        belongs to tile r // tile_len and its band slots map to target
+        columns through THAT tile's origin. The lookup is an extra
+        independent gather per position — it rides the same dependent
+        step as the cells gather, so the dual-column latency chain is
+        unchanged. Requires ``tile_len`` > 0; ``klo`` is ignored.
+      emit: emission dtype of the returned channels (default int16 — the
+        consensus path's pinned layout). The tiled overlap path passes
+        int32: qstart/qi_c hold absolute query indices, which overflow
+        int16 past 32 kb. (The jax 0.9 reverse-scan miscompile below is
+        specific to TUPLES of narrow-dtype ys; a single stacked ys is
+        safe at either width.)
 
-    Returns dict of anchor-indexed arrays (all [B, LA+2] int16 except
-    ``sat`` bool[B]); row p describes the walk step at j = p - t_off:
+    Returns dict of anchor-indexed arrays (all [B, LA+2] of ``emit``
+    dtype except ``sat`` bool[B]); row p describes the walk step at
+    j = p - t_off:
       ins_len[p] — insertion-run length at gap j
       qstart[p]  — query index of the first inserted base at gap j
       op_c[p]    — direction consuming column j - 1 (PAD_OP at j == 0)
@@ -95,6 +110,13 @@ def col_walk(cells, lq, lt, klo, t_off, *, LA: int, layout: str,
     lt = lt.astype(jnp.int32)
     lq = lq.astype(jnp.int32)
     t_off = t_off.astype(jnp.int32)
+    if emit is None:
+        emit = jnp.int16
+    if tile_klo is not None:
+        if tile_len <= 0:
+            raise ValueError("[racon_tpu::colwalk] tile_klo needs tile_len")
+        tk1 = tile_klo.astype(jnp.int32).reshape(-1)
+        n_tiles = tile_klo.shape[0]
 
     def cell_idx(i, jc):
         # Flat index of cell (i, jc)'s packed byte: row i-1 of the
@@ -103,7 +125,12 @@ def col_walk(cells, lq, lt, klo, t_off, *, LA: int, layout: str,
         if layout == "flat":
             col = jnp.maximum(jc - 1, 0)
             return r * (B * W) + lane * W + col
-        x = jnp.clip(jc - i - klo, 0, W - 1)
+        if tile_klo is None:
+            kl = klo
+        else:
+            tl = jnp.clip(r // tile_len, 0, n_tiles - 1)
+            kl = jnp.take(tk1, tl * B + lane)
+        x = jnp.clip(jc - i - kl, 0, W - 1)
         if layout == "band_t":
             return r * (B * W) + x * B + lane
         return r * (B * W) + lane * W + x
@@ -132,7 +159,7 @@ def col_walk(cells, lq, lt, klo, t_off, *, LA: int, layout: str,
         cons = jnp.where(is_j0, PAD_OP, cons)
         qi = top - jnp.where(cons == DIAG, 1, 0)
         i_next = jnp.where(active, jnp.where(is_j0, 0, qi), i)
-        out = jnp.stack([u_eff, top, cons, qi], axis=-1).astype(jnp.int16)
+        out = jnp.stack([u_eff, top, cons, qi], axis=-1).astype(emit)
         return i_next, sat | newsat, out
 
     def substep(i, sat, p):

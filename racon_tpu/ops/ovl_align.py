@@ -18,10 +18,18 @@ tunnel).
 Exactness contract (same as the consensus engine): per-lane banded
 optimality is certified by the tightened escape bound; lanes that fail
 it — or whose walk saturated an up-run counter — are returned to the
-caller for the native aligner fallback. Jobs too long for the device
-budget (band width must grow ~Lq/7 to certify at ONT error rates, and
-128 * Lq * W is capped by the int32 flat-index budget, so ~9 kb is the
-practical ceiling) skip the device entirely.
+caller for the native aligner fallback.
+
+Length routing (round 7): jobs that fit the untiled whole-read budget
+(~9 kb at the 128-lane grid) run exactly as before, bit-identically.
+Longer jobs no longer skip the device: they route through the TILED
+forward (``_tiled_chunk_breaking_points``) — a lax.scan over
+query-axis tiles of the frontier-carrying band kernel
+(band_kernel.fw_dirs_band_tile), with per-tile band re-centering, a
+staircase escape certificate over the running band clearance, and a
+stitched column walk over the per-tile slabs. Admission comes from
+budget.tile_plan's (lanes, W, T, ch) tier table; only jobs no tier
+admits (or whose certificate fails) reach the native path.
 """
 
 from __future__ import annotations
@@ -29,6 +37,7 @@ from __future__ import annotations
 from typing import List, Optional, Tuple
 
 import functools
+import os
 
 import numpy as np
 
@@ -36,7 +45,8 @@ from racon_tpu.ops.cigar import DIAG
 from racon_tpu.ops.device_poa import _packed_byte_slice, _round_up
 from racon_tpu.ops.pallas.band_kernel import TB   # lane grid (= chunk B)
 from racon_tpu.ops.budget import (VMEM_BUDGET as _VMEM_BUDGET,
-                                  max_dir_elems, vmem_est as _vmem_est)
+                                  max_dir_elems, tile_plan,
+                                  vmem_est as _vmem_est)
 # Dirs/nxt-plane element budget: the column walk's flat gather index
 # must stay under 2^31 and each plane's HBM buffer under the TPU's 2 GB
 # single-buffer ceiling. Derived in racon_tpu/ops/budget.py, SHARED with
@@ -152,6 +162,180 @@ def _chunk_breaking_points(q, t, lq, lt, t_begin, *, match, mismatch, gap,
     return first_c, qi_f, last_c, qi_l, valid, fail
 
 
+@functools.partial(
+    __import__("jax").jit,
+    static_argnames=("match", "mismatch", "gap", "W", "w_len", "NW", "Lq",
+                     "LA", "T", "tb", "ch", "pallas"))
+def _tiled_chunk_breaking_points(q, t, lq, lt, t_begin, *, match, mismatch,
+                                 gap, W, w_len, NW, Lq, LA, T, tb, ch,
+                                 pallas):
+    """One ULTRALONG device chunk: lax.scan over query-axis tiles of the
+    frontier-carrying band kernel, then one stitched column walk.
+
+    Per tile the scan (one kernel compile serves every tile — the row
+    origin i0 is a runtime input):
+
+    1. gathers the tile's pre-shifted target window at the CURRENT band
+       origin klo (re-centered between tiles, so each tile is a straight
+       band but the tile sequence forms a staircase that can track
+       |lt - lq| <= W/2 of drift),
+    2. runs fw_dirs_band_tile / its XLA twin with the carried frontier
+       (H row i0, packed (N,U,C) metadata of row i0, running hlast),
+    3. updates the running band clearance ``cmin`` — the certificate
+       below needs the MINIMUM distance from any tile's band edges to
+       the legal-origin interval, and
+    4. re-centers klo on the frontier argmax with a W/4..3W/4 dead zone
+       (no-drift reads keep klo fixed and are bit-identical to the
+       untiled straight band) clamped to [max(0,d)-W+1, min(0,d)] — the
+       clamp keeps both DP corners reachable, so the terminal cell
+       x_end = lt - lq - klo stays inside [0, W) at every tile and the
+       captured end score survives the frontier shifts. The frontier
+       shifts by d = klo' - klo (score fill NEG, metadata fill
+       UC_BOUNDARY, hlast fill NEG — a shifted-out terminal score would
+       mean the clamp proof was violated, and NEG fails the certificate
+       rather than fabricating a result).
+
+    The per-tile klo values are stacked and handed to the column walk
+    (colwalk.py tile_klo), which maps stored row r through tile
+    r // T's origin; the dual-column nxt contract survives tile
+    boundaries unchanged because nxt bytes carry predecessor VALUES,
+    not band slots. Emissions are int32 (absolute query indices exceed
+    int16 past 32 kb).
+
+    Staircase escape certificate: a path leaving the tiled band must
+    cross a band edge at some tile, where its clearance to the legal
+    diagonals is at least cmin, so (same counting as the straight-band
+    bound with wl := cmin)
+
+        score >= max(m,0)*(min(lq,lt) - cmin - 1)
+                 + gap*(|lt - lq| + 2*cmin + 2)
+
+    certifies banded == global. With the dead zone inactive cmin == wl
+    and this is exactly the untiled bound.
+
+    Returns the same tuple contract as _chunk_breaking_points.
+    """
+    import jax
+    import jax.numpy as jnp
+    from racon_tpu.ops.colwalk import col_walk
+    from racon_tpu.ops.pallas.band_kernel import (
+        UC_BOUNDARY, fw_dirs_band_tile, fw_dirs_band_xla_tile)
+
+    B = q.shape[0]
+    n_tiles = Lq // T
+    NEG = -(2 ** 30)
+    lanei = jnp.arange(B, dtype=jnp.int32)
+    xr = jnp.arange(W, dtype=jnp.int32)[None, :]
+    delta = lt - lq
+    # Legal band-origin interval: klo must keep (0, 0) reachable
+    # (klo <= 0 via klo_hi; start corner at x = -klo < W via klo_lo) and
+    # the terminal (lq, lt) in band (x_end = delta - klo in [0, W)).
+    klo_lo = jnp.maximum(0, delta) - (W - 1)
+    klo_hi = jnp.minimum(0, delta)
+    wl = (W - 1 - jnp.abs(delta)) // 2
+    klo0 = jnp.clip(jnp.minimum(0, delta) - wl, klo_lo, klo_hi)
+    j00 = klo0[:, None] + xr
+    prev0 = jnp.where(j00 >= 0, j00 * gap, NEG).astype(jnp.int32)
+    uc0 = jnp.full((B, W), UC_BOUNDARY, jnp.int32)
+    hl0 = prev0
+
+    PW = W + T
+    tab = jnp.concatenate(
+        [jnp.zeros((PW,), jnp.uint8), t.reshape(-1),
+         jnp.zeros((PW,), jnp.uint8)])
+    y = jnp.arange(PW, dtype=jnp.int32)[None, :]
+    qT = q.T
+
+    def tile_body(carry, i0):
+        prev, uc, hl, klo, cmin = carry
+        cmin = jnp.minimum(
+            cmin, jnp.minimum(klo_hi - klo, klo - klo_lo))
+        # This tile's pre-shifted target window at the current origin:
+        # tband[b, y] = t[b, klo_b + i0 + y] (bucketing guarantees
+        # LA >= Lq, so the padded-table slice stays in range).
+        rel = klo[:, None] + i0 + y
+        okb = (rel >= 0) & (rel < lt[:, None])
+        start = lanei * LA + klo + i0 + PW
+        sl = _packed_byte_slice(tab, start, PW)
+        tband = jnp.where(okb, sl, 7).astype(jnp.uint8)
+        qT_t = jax.lax.dynamic_slice_in_dim(qT, i0, T, axis=0)
+        i0v = jnp.full((B,), i0, jnp.int32)
+        if pallas:
+            dirs, nxt, hl2, prev2, uc2 = fw_dirs_band_tile(
+                tband, qT_t, klo, lq, i0v, prev, uc, hl, match=match,
+                mismatch=mismatch, gap=gap, W=W, tb=tb, ch=ch)
+        else:
+            dirs, nxt, hl2, prev2, uc2 = fw_dirs_band_xla_tile(
+                tband, qT_t, klo, lq, i0v, prev, uc, hl, match=match,
+                mismatch=mismatch, gap=gap, W=W)
+        # Dead-zone re-centering on the frontier argmax (step 4 above).
+        xstar = jnp.argmax(prev2, axis=1).astype(jnp.int32)
+        shift = jnp.where(xstar < W // 4, xstar - W // 4,
+                          jnp.where(xstar > (3 * W) // 4,
+                                    xstar - (3 * W) // 4, 0))
+        klo_n = jnp.clip(klo + shift, klo_lo, klo_hi)
+        d = klo_n - klo
+        xi = xr + d[:, None]
+        okx = (xi >= 0) & (xi < W)
+        xig = jnp.clip(xi, 0, W - 1)
+        prev3 = jnp.where(
+            okx, jnp.take_along_axis(prev2, xig, axis=1), NEG)
+        uc3 = jnp.where(
+            okx, jnp.take_along_axis(uc2, xig, axis=1), UC_BOUNDARY)
+        hl3 = jnp.where(
+            okx, jnp.take_along_axis(hl2, xig, axis=1), NEG)
+        return (prev3, uc3, hl3, klo_n, cmin), (dirs, nxt, klo)
+
+    i0s = jnp.arange(n_tiles, dtype=jnp.int32) * T
+    carry0 = (prev0, uc0, hl0, klo0,
+              jnp.full(klo0.shape, 2 ** 30, jnp.int32))
+    (_, _, hlF, kloF, cmin), (dslab, nslab, klos) = jax.lax.scan(
+        tile_body, carry0, i0s)
+    # Stacked per-tile slabs ARE the whole-read tensors: [n_tiles, T,
+    # W, B] -> [Lq, W, B] (kernel layout; twin analogous) with rows in
+    # global order.
+    if pallas:
+        cells = dslab.reshape(Lq, W, B)
+        nxtp = nslab.reshape(Lq, W, B)
+    else:
+        cells = dslab.reshape(Lq, B, W)
+        nxtp = nslab.reshape(Lq, B, W)
+    cols = col_walk(cells, lq, lt, None, jnp.zeros(B, jnp.int32), LA=LA,
+                    layout="band_t" if pallas else "band", nxt=nxtp,
+                    tile_klo=klos, tile_len=T, emit=jnp.int32)
+
+    # hlF rides the frontier shifts, so the terminal cell is indexed
+    # through the FINAL origin; the clamp proof keeps it in [0, W).
+    xend = jnp.clip(lt - lq - kloF, 0, W - 1)
+    score = jnp.take_along_axis(hlF, xend[:, None], axis=1)[:, 0]
+    bound = (jnp.maximum(match, 0) * (jnp.minimum(lq, lt) - cmin - 1) +
+             gap * (jnp.abs(delta) + 2 * cmin + 2))
+    fail = ((score < bound) | (cmin < 16)).astype(jnp.float32) + \
+        cols["sat"].astype(jnp.float32)
+
+    op = cols["op_c"][:, 1:LA + 1]
+    qi = cols["qi_c"][:, 1:LA + 1]
+    c = jnp.arange(LA, dtype=jnp.int32)[None, :]
+    is_m = (c < lt[:, None]) & (op == DIAG)
+    widx = (t_begin[:, None] + c) // w_len - (t_begin // w_len)[:, None]
+    # Scatter-reduce per window instead of the untiled path's per-window
+    # Python loop: LA // w_len reaches ~230 at 114 kb reads, and the
+    # loop's NW full-[B, LA] masked reductions would dominate the walk.
+    wc = jnp.clip(widx, 0, NW - 1)
+    rows = jnp.broadcast_to(lanei[:, None], (B, LA))
+    HUGE = 2 ** 30
+    first_c = jnp.full((B, NW), HUGE, jnp.int32).at[rows, wc].min(
+        jnp.where(is_m, c, HUGE))
+    last_c = jnp.full((B, NW), -1, jnp.int32).at[rows, wc].max(
+        jnp.where(is_m, c, -1))
+    valid = last_c >= 0
+    qi_f = jnp.take_along_axis(qi, jnp.clip(first_c, 0, LA - 1), axis=1)
+    qi_l = jnp.take_along_axis(qi, jnp.clip(last_c, 0, LA - 1), axis=1)
+    # Trailing klos [n_tiles, B] is observability for tests/debugging
+    # (which tiles re-centered); the collect loop reads out[:6] only.
+    return first_c, qi_f, last_c, qi_l, valid, fail, klos
+
+
 def device_breaking_points(pending, sequences, window_length: int, *,
                            match: int, mismatch: int, gap: int,
                            log=None) -> List:
@@ -163,33 +347,54 @@ def device_breaking_points(pending, sequences, window_length: int, *,
     every handled overlap — ``find_breaking_points`` then no-ops.
     """
     import jax
+    from racon_tpu.obs import metrics as obs_metrics
+    from racon_tpu.obs import trace as _trace
     from racon_tpu.ops.encode import encode_bases
 
-    jobs = []      # (overlap, q_codes, t_codes, q_start)
+    tracer = _trace.get_tracer()
+    tiled_on = os.environ.get("RACON_TPU_OVL_TILED", "1") != "0"
+    jobs = []        # (overlap, q_codes, t_codes, q_start)
+    tiled_jobs = []  # (overlap, q_codes, t_codes, q_start, plan)
     fallback = []
+    # The two fallback causes are counted INDEPENDENTLY, at the point
+    # each is known: n_budget here at classification, n_uncert at
+    # collect. The old `len(pending) - len(jobs)` subtraction lumped
+    # uncertified lanes in with over-budget ones whenever both occurred
+    # in one batch.
+    n_budget = 0
+    n_uncert = 0
     for o in pending:
         qb, tb = o.alignment_operands(sequences)
         lq, lt = len(qb), len(tb)
         if lq < 1 or lt < 1:
             fallback.append(o)
-            continue
-        W = _round_up(band_width_for_read(lq, lt), 512)
-        lqp = _round_up(lq, 2048)
-        if (TB * lqp * W > MAX_DIR_ELEMS or
-                _vmem_est(W, lqp, 4) > _VMEM_BUDGET or
-                max(lq, lt) >= 2 ** 14):   # int16 walk emissions
-            fallback.append(o)
+            n_budget += 1
             continue
         q_start = o.q_begin if not o.strand else o.q_length - o.q_end
-        jobs.append((o, encode_bases(bytes(qb)), encode_bases(bytes(tb)),
-                     q_start))
-    if not jobs:
+        W = _round_up(band_width_for_read(lq, lt), 512)
+        lqp = _round_up(lq, 2048)
+        if (TB * lqp * W <= MAX_DIR_ELEMS and
+                _vmem_est(W, lqp, 4) <= _VMEM_BUDGET and
+                max(lq, lt) < 2 ** 14):   # int16 walk emissions
+            jobs.append((o, encode_bases(bytes(qb)),
+                         encode_bases(bytes(tb)), q_start))
+            continue
+        plan = tile_plan(lq, lt) if tiled_on else None
+        if plan is not None:
+            tiled_jobs.append((o, encode_bases(bytes(qb)),
+                               encode_bases(bytes(tb)), q_start, plan))
+        else:
+            fallback.append(o)
+            n_budget += 1
+    if not jobs and not tiled_jobs:
         # A fully-rejected set must still say so — this exact condition
         # once hid the genome workload falling back wholesale.
         if log is not None and fallback:
             print(f"[racon_tpu::Polisher::initialize] all {len(pending)} "
                   "overlap alignments exceed the device length budget; "
                   "using the native path", file=log)
+        obs_metrics.record_ovl(device_jobs=0, native_jobs=len(fallback),
+                               tiles=0)
         return fallback
 
     pallas = jax.default_backend() in ("tpu", "axon")
@@ -224,10 +429,26 @@ def device_breaking_points(pending, sequences, window_length: int, *,
     if cur:
         buckets.append((cur, Lq, LA, W))
 
+    # Tiled jobs bucket per tier (lanes, W, T, ch): every member passed
+    # tile_plan's element gate at ITS OWN padded Lq, and the bucket's
+    # running maxima only ever equal some member's padding, so one
+    # bucket per tier never overflows the cap. LA additionally rides up
+    # to Lq — the per-tile tband slice into the padded target table
+    # indexes lane*LA + klo + i0 + y and needs LA >= Lq to stay inside
+    # the neighbouring-lane slack (_tiled_chunk_breaking_points).
+    tiled_buckets = []
+    bytier = {}
+    for j in tiled_jobs:
+        bytier.setdefault(j[4].key(), []).append(j)
+    for (lanes, W_t, T_t, ch_t), js in sorted(bytier.items()):
+        js.sort(key=lambda j: (len(j[1]), len(j[2])))
+        Lq_t = max(_round_up(len(j[1]), T_t) for j in js)
+        LA_t = max(Lq_t, max(_round_up(len(j[2]), 2048) for j in js))
+        tiled_buckets.append((js, lanes, W_t, T_t, ch_t, Lq_t, LA_t))
+
     # Dispatch every chunk before collecting any: jit calls are async,
     # so chunk i+1's h2d overlaps chunk i's compute (the tunnel's h2d
     # otherwise serializes with device time).
-    import os
     import sys as _sys
     import time as _time
     verbose = os.environ.get("RACON_TPU_TIMING", "") not in ("", "0")
@@ -249,21 +470,60 @@ def device_breaking_points(pending, sequences, window_length: int, *,
                 lq[b] = len(qc)
                 lt[b] = len(tc)
                 t_begin[b] = o.t_begin
-            pending_out.append((sub, _chunk_breaking_points(
-                q, t, lq, lt, t_begin, match=match, mismatch=mismatch,
-                gap=gap, W=W, w_len=window_length, NW=NW, Lq=Lq, LA=LA,
-                pallas=pallas)))
+            with tracer.span("dispatch", "ovl_chunk", lanes=B, W=W):
+                pending_out.append((sub, _chunk_breaking_points(
+                    q, t, lq, lt, t_begin, match=match, mismatch=mismatch,
+                    gap=gap, W=W, w_len=window_length, NW=NW, Lq=Lq, LA=LA,
+                    pallas=pallas)))
+
+    n_tiles_exec = 0
+    for bucket, lanes, W, T, ch, Lq, LA in tiled_buckets:
+        NW = LA // window_length + 2
+        n_tiles = Lq // T
+        for s in range(0, len(bucket), lanes):
+            sub = bucket[s:s + lanes]
+            # Lane count adapts down to the actual job count (pow2,
+            # min 8): the stitched tensors scale with B, and a 3-job
+            # tail chunk at 64 lanes would pay 21x the forward work.
+            B = lanes
+            while B // 2 >= max(8, len(sub)):
+                B //= 2
+            q = np.zeros((B, Lq), np.uint8)
+            t = np.zeros((B, LA), np.uint8)
+            lq = np.ones(B, np.int32)
+            lt = np.ones(B, np.int32)
+            t_begin = np.zeros(B, np.int32)
+            for b, (o, qc, tc, _, _) in enumerate(sub):
+                q[b, :len(qc)] = qc
+                t[b, :len(tc)] = tc
+                lq[b] = len(qc)
+                lt[b] = len(tc)
+                t_begin[b] = o.t_begin
+            with tracer.span("dispatch", "ovl_tiled_chunk", lanes=B,
+                             W=W, tiles=n_tiles):
+                for ti in range(n_tiles):
+                    tracer.point("tile", f"t{ti}", index=ti, rows=T, W=W)
+                pending_out.append((sub, _tiled_chunk_breaking_points(
+                    q, t, lq, lt, t_begin, match=match, mismatch=mismatch,
+                    gap=gap, W=W, w_len=window_length, NW=NW, Lq=Lq,
+                    LA=LA, T=T, tb=B, ch=ch, pallas=pallas)))
+            n_tiles_exec += n_tiles
 
     if verbose:
         print(f"[racon_tpu::ovl_align] dispatch {len(pending_out)} "
-              f"chunks ({len(buckets)} shape buckets): "
+              f"chunks ({len(buckets)} shape buckets, "
+              f"{len(tiled_buckets)} tiled tiers): "
               f"{_time.perf_counter() - t_disp:.2f}s", file=_sys.stderr)
         t_disp = _time.perf_counter()
     for sub, out in pending_out:
-        first_c, qi_f, last_c, qi_l, valid, fail = map(np.asarray, out)
-        for b, (o, _, _, q_start) in enumerate(sub):
+        # Untiled chunks return 6 fields; tiled chunks append a klos
+        # observability field that the collect path does not consume.
+        first_c, qi_f, last_c, qi_l, valid, fail = map(np.asarray, out[:6])
+        for b, job in enumerate(sub):
+            o, q_start = job[0], job[3]
             if fail[b]:
                 fallback.append(o)
+                n_uncert += 1
                 continue
             v = valid[b]
             rows = np.stack([
@@ -276,10 +536,12 @@ def device_breaking_points(pending, sequences, window_length: int, *,
     if verbose:
         print(f"[racon_tpu::ovl_align] collect: "
               f"{_time.perf_counter() - t_disp:.2f}s", file=_sys.stderr)
+    obs_metrics.record_ovl(
+        device_jobs=len(jobs) + len(tiled_jobs) - n_uncert,
+        native_jobs=len(fallback), tiles=n_tiles_exec)
     if log is not None and fallback:
-        n_budget = len(pending) - len(jobs)
         print(f"[racon_tpu::Polisher::initialize] {len(fallback)} of "
               f"{len(pending)} overlap alignments fall back to the "
               f"native path ({n_budget} over the device length budget, "
-              f"{len(fallback) - n_budget} uncertified)", file=log)
+              f"{n_uncert} uncertified)", file=log)
     return fallback

@@ -349,6 +349,264 @@ def fw_dirs_band_xla(tband: jnp.ndarray, qT: jnp.ndarray, klo: jnp.ndarray,
     return ys[:, 0], ys[:, 1], hlast.astype(jnp.int32)
 
 
+UC_BOUNDARY = (LEFT << 6) | LEFT   # row-0 / out-of-band packed (N,U,C)
+
+
+def _kernel_tile(tbandT_ref, qT_ref, klo_ref, lq_ref, i0_ref, pin_ref,
+                 ucin_ref, hlin_ref, dirs_ref, nxt_ref, hlast_ref,
+                 prev_ref, ucprev_ref, *, match, mismatch, gap, W,
+                 dtype, TB, CH):
+    # Tiled variant of _kernel for the ultralong overlap path: identical
+    # row recurrence, but rows are numbered from a runtime tile origin
+    # i0 (so ONE compiled kernel serves every tile of a lax.scan over
+    # tiles), and the DP frontier — last band row of scores, packed
+    # (N << 6 | U << 2 | C) metadata, and the captured hlast — enters as
+    # inputs and leaves as outputs instead of being scratch-initialized.
+    # Kept as a separate body rather than a parameterization of _kernel:
+    # the untiled kernel is the consensus path's pinned production
+    # kernel, and this stack's Mosaic quirks (PROFILE.md "Platform
+    # findings") make "refactor shared, hope TPU lowering is unchanged"
+    # a bad trade against ~60 duplicated lines.
+    c = pl.program_id(1)
+    NEG = _NEG16 if dtype == jnp.int16 else _NEG
+    xr = jax.lax.broadcasted_iota(jnp.int32, (W, TB), 0)
+    klo = klo_ref[0]                       # [TB] int32 (this tile's band)
+    lqv = lq_ref[0]                        # [TB] int32
+    i0 = i0_ref[0][None, :]                # (1, TB) int32 tile row origin
+
+    @pl.when(c == 0)
+    def _():
+        prev_ref[:] = pin_ref[:]
+        ucprev_ref[:] = ucin_ref[:]
+        hlast_ref[:] = hlin_ref[:]
+
+    def row(r, _):
+        rl = c * CH + r + 1                # 1-based row within the tile
+        i = i0 + rl                        # (1, TB) global 1-based row
+        qrow = qT_ref[r]                   # [TB] int32
+        tw = tbandT_ref[pl.dslice(rl - 1, W), :]
+        jcol = i + klo[None, :] + xr       # absolute target column j
+        sub = jnp.where(tw == qrow[None, :], match, mismatch)
+        sub = jnp.where(jcol >= 1, sub, NEG).astype(dtype)
+        P = prev_ref[:]
+        diag = P + sub
+        up = jnp.concatenate(
+            [P[1:, :], jnp.full((1, TB), NEG, dtype)], axis=0) + \
+            jnp.asarray(gap, dtype)
+        tmp = jnp.maximum(diag, up)
+        tmp = jnp.where(jcol == 0, i * gap, tmp).astype(dtype)
+        tmp = jnp.maximum(tmp, jnp.asarray(NEG, dtype))
+        jg = (jcol * gap).astype(dtype)
+        f = tmp - jg
+        s = 1
+        while s < W:
+            f = jnp.maximum(
+                f, jnp.concatenate(
+                    [jnp.full((s, TB), NEG, dtype), f[:-s, :]],
+                    axis=0))
+            s *= 2
+        h = f + jg
+        h = jnp.where(jcol >= 0, h, NEG).astype(dtype)
+        d = jnp.where(h == diag, jnp.asarray(DIAG, dtype),
+                      jnp.where(h == up, jnp.asarray(UP, dtype),
+                                jnp.asarray(LEFT, dtype))).astype(jnp.int32)
+        isup = d == UP
+        ucp = ucprev_ref[:]
+        ucup = jnp.concatenate(
+            [ucp[1:, :], jnp.full((1, TB), UC_BOUNDARY, jnp.int32)],
+            axis=0)
+        U = jnp.where(isup, jnp.minimum(((ucup >> 2) & 0xF) + 1, U_SAT), 0)
+        C = jnp.where(isup, ucup & 3, d)
+        ucnow = (U << 2) + C
+        nleft = jnp.concatenate(
+            [jnp.full((1, TB), LEFT, jnp.int32), ucnow[:-1, :]], axis=0)
+        N = jnp.where(isup, ucup >> 6,
+                      jnp.where(d == DIAG, ucp & 0x3F, nleft))
+        dirs_ref[r] = (d + (C << 2) + (U << 4)).astype(jnp.uint8)
+        nxt_ref[r] = N.astype(jnp.uint8)
+        ucprev_ref[:] = (N << 6) + ucnow
+        prev_ref[:] = h
+        hlast_ref[:] = jnp.where(lqv[None, :] == i, h, hlast_ref[:])
+        return 0
+
+    jax.lax.fori_loop(0, CH, row, 0)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("match", "mismatch", "gap", "W",
+                                    "tb", "ch", "interpret"))
+def fw_dirs_band_tile(tband: jnp.ndarray, qT: jnp.ndarray,
+                      klo: jnp.ndarray, lq: jnp.ndarray, i0: jnp.ndarray,
+                      prev: jnp.ndarray, uc: jnp.ndarray,
+                      hlast: jnp.ndarray, *, match: int, mismatch: int,
+                      gap: int, W: int, tb: int = TB, ch: int = CH,
+                      interpret: bool = False):
+    """One query-axis tile of the banded forward with an explicit DP
+    frontier (Pallas).
+
+    Args:
+      tband: uint8/int32[B, W + T] targets pre-shifted for THIS tile:
+             ``tband[b, y] = target_b[klo_b + i0_b + y]`` (fill 7).
+      qT:    uint8/int32[T, B] this tile's query rows, transposed.
+      klo:   int32[B] this tile's band origin (may differ per tile after
+             re-centering; ops/ovl_align.py records the per-tile values
+             for the stitched column walk).
+      lq/i0: int32[B] query lengths / 0-based global row origin of the
+             tile (rows i0+1 .. i0+T are computed; i0 is identical
+             across lanes of one dispatch but ships as a lane vector so
+             the kernel stays shape-stable under lax.scan).
+      prev/uc/hlast: int32[B, W] carried frontier — H[i0] over the band,
+             the packed ``(N << 6) | (U << 2) | C`` metadata of row i0,
+             and the running final-row capture. For tile 0 the caller
+             passes the same init the untiled kernel builds internally
+             (j0*gap / UC_BOUNDARY / init), making a single-tile call
+             bit-identical to :func:`fw_dirs_band`.
+
+    Returns (cells uint8[T, W, B], nxt uint8[T, W, B], hlast int32[B, W],
+    prev int32[B, W], uc int32[B, W]) — the trailing three are the
+    frontier after row i0+T, in the SAME band coordinates as the input
+    (the caller shifts them when it re-centers klo for the next tile).
+    Scores are always int32: frontier magnitudes grow with the GLOBAL
+    query length, which this per-tile entry point cannot bound.
+    """
+    B = tband.shape[0]
+    T = qT.shape[0]
+    dtype = jnp.int32
+    kernel = functools.partial(_kernel_tile, match=match,
+                               mismatch=mismatch, gap=gap, W=W,
+                               dtype=dtype, TB=tb, CH=ch)
+    dirs, nxt, hl, pout, ucout = pl.pallas_call(
+        kernel,
+        grid=(B // tb, T // ch),
+        in_specs=[
+            pl.BlockSpec((W + T, tb), lambda b, c: (0, b),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((ch, tb), lambda b, c: (c, b),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tb), lambda b, c: (0, b),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tb), lambda b, c: (0, b),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tb), lambda b, c: (0, b),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((W, tb), lambda b, c: (0, b),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((W, tb), lambda b, c: (0, b),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((W, tb), lambda b, c: (0, b),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((ch, W, tb), lambda b, c: (c, 0, b),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((ch, W, tb), lambda b, c: (c, 0, b),
+                         memory_space=pltpu.VMEM),
+            # Frontier outputs persist across the sequential c steps via
+            # the constant index map — same contract the untiled
+            # kernel's hlast output already relies on.
+            pl.BlockSpec((W, tb), lambda b, c: (0, b),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((W, tb), lambda b, c: (0, b),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((W, tb), lambda b, c: (0, b),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T, W, B), jnp.uint8),
+            jax.ShapeDtypeStruct((T, W, B), jnp.uint8),
+            jax.ShapeDtypeStruct((W, B), dtype),
+            jax.ShapeDtypeStruct((W, B), dtype),
+            jax.ShapeDtypeStruct((W, B), jnp.int32),
+        ],
+        compiler_params=_CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(tband.astype(jnp.int32).T, qT.astype(jnp.int32),
+      klo[None, :], lq[None, :], i0[None, :],
+      prev.astype(dtype).T, uc.astype(jnp.int32).T,
+      hlast.astype(dtype).T)
+    return (dirs, nxt, hl.T.astype(jnp.int32), pout.T.astype(jnp.int32),
+            ucout.T)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("match", "mismatch", "gap", "W"))
+def fw_dirs_band_xla_tile(tband: jnp.ndarray, qT: jnp.ndarray,
+                          klo: jnp.ndarray, lq: jnp.ndarray,
+                          i0: jnp.ndarray, prev: jnp.ndarray,
+                          uc: jnp.ndarray, hlast: jnp.ndarray, *,
+                          match: int, mismatch: int, gap: int, W: int):
+    """Row-scan twin of fw_dirs_band_tile (CPU tests / non-TPU
+    fallback); bit-identical outputs by construction. Cells/nxt come
+    back [T, B, W] (vs the kernel's [T, W, B]), like the untiled pair.
+    """
+    B = tband.shape[0]
+    T = qT.shape[0]
+    dtype = jnp.int32
+    NEG = _NEG
+    xr = jnp.arange(W, dtype=jnp.int32)[None, :]
+    t32 = tband.astype(jnp.int32)
+    P0 = prev.astype(dtype)
+    hl0 = hlast.astype(dtype)
+    U0 = (uc >> 2) & 0xF
+    C0 = uc & 3
+    N0 = (uc >> 6) & 0x3F
+
+    def step(carry, inp):
+        P, hl, Up, Cp, Np = carry
+        rl, qrow = inp
+        i = (i0 + rl)[:, None]             # (B, 1) global 1-based row
+        tw = jax.lax.dynamic_slice_in_dim(t32, rl - 1, W, axis=1)
+        jcol = i + klo[:, None] + xr
+        sub = jnp.where(tw == qrow[:, None], match, mismatch)
+        sub = jnp.where(jcol >= 1, sub, NEG).astype(dtype)
+        diag = P + sub
+        up = jnp.concatenate(
+            [P[:, 1:], jnp.full((B, 1), NEG, dtype)], axis=1) + \
+            jnp.asarray(gap, dtype)
+        tmp = jnp.maximum(diag, up)
+        tmp = jnp.where(jcol == 0, i * gap, tmp).astype(dtype)
+        tmp = jnp.maximum(tmp, jnp.asarray(NEG, dtype))
+        jg = (jcol * gap).astype(dtype)
+        f = tmp - jg
+        s = 1
+        while s < W:
+            f = jnp.maximum(
+                f, jnp.concatenate(
+                    [jnp.full((B, s), NEG, dtype), f[:, :-s]],
+                    axis=1))
+            s *= 2
+        h = f + jg
+        h = jnp.where(jcol >= 0, h, NEG).astype(dtype)
+        d = jnp.where(h == diag, DIAG,
+                      jnp.where(h == up, UP, LEFT))
+        isup = d == UP
+        uup = jnp.concatenate(
+            [Up[:, 1:], jnp.zeros((B, 1), jnp.int32)], axis=1)
+        cup = jnp.concatenate(
+            [Cp[:, 1:], jnp.full((B, 1), LEFT, jnp.int32)], axis=1)
+        nup = jnp.concatenate(
+            [Np[:, 1:], jnp.full((B, 1), LEFT, jnp.int32)], axis=1)
+        U = jnp.where(isup, jnp.minimum(uup + 1, U_SAT), 0)
+        C = jnp.where(isup, cup, d)
+        ucnow = (U << 2) + C
+        nleft = jnp.concatenate(
+            [jnp.full((B, 1), LEFT, jnp.int32), ucnow[:, :-1]], axis=1)
+        N = jnp.where(isup, nup,
+                      jnp.where(d == DIAG, (Up << 2) + Cp, nleft))
+        packed = (d + (C << 2) + (U << 4)).astype(jnp.uint8)
+        hl = jnp.where((lq == i[:, 0])[:, None], h, hl)
+        return (h, hl, U, C, N), jnp.stack(
+            [packed, N.astype(jnp.uint8)], axis=0)
+
+    ii = jnp.arange(1, T + 1, dtype=jnp.int32)
+    (Pf, hlf, Uf, Cf, Nf), ys = jax.lax.scan(
+        step, (P0, hl0, U0, C0, N0), (ii, qT.astype(jnp.int32)))
+    ucout = (Nf << 6) + (Uf << 2) + Cf
+    return (ys[:, 0], ys[:, 1], hlf.astype(jnp.int32),
+            Pf.astype(jnp.int32), ucout)
+
+
 def band_geometry(lq, lt, W: int):
     """Per-lane (klo, wl) for a W-slot band (all int32 vectors)."""
     delta = lt - lq

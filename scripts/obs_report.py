@@ -33,6 +33,9 @@ KIND_REQUIRED_ATTRS = {
     "retry": ("attempt", "error"),
     "fault": ("index", "action"),
     "checkpoint": ("tid", "bytes"),
+    # One query-axis tile of the tiled ultralong overlap forward,
+    # emitted under the ovl_tiled_chunk dispatch span (ops/ovl_align.py).
+    "tile": ("index", "rows", "W"),
 }
 
 # Span intervals are rounded to 1e-6 on write and a parent's clock stops
@@ -140,7 +143,7 @@ def render(tr: Dict[str, object], out=sys.stdout) -> None:
         by_kind.setdefault(s["kind"], []).append(s)
 
     for kind in ("phase", "pipeline", "stage", "chunk", "round",
-                 "dispatch"):
+                 "dispatch", "tile"):
         rows = by_kind.get(kind)
         if not rows:
             continue

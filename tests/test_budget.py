@@ -74,3 +74,61 @@ def test_cell_bytes_validation():
         pass
     else:
         raise AssertionError("cell_bytes=0 must be rejected")
+
+
+# ---------------------------------------------------------------------------
+# Tiled overlap admission (round 7): every tier and every plan the picker
+# can emit must stay under the int32 flat-index cap, the 2 GB buffer
+# ceiling, and the per-tile VMEM budget.
+# ---------------------------------------------------------------------------
+
+
+def test_tile_tiers_respect_all_budgets():
+    cap = budget.max_dir_elems(1)
+    for lanes, W, T, ch in budget.TILE_TIERS:
+        # Per-tile kernel blocks fit VMEM.
+        assert budget.vmem_est(W, T, ch) <= budget.VMEM_BUDGET
+        # The tier admits at least one tile's worth of rows under the
+        # element cap (otherwise it could never fire).
+        assert lanes * T * W <= cap
+        # Tile height divides into kernel row-chunks and the grid.
+        assert T % ch == 0
+        # Lane counts stay powers of two so the adaptive lane halving in
+        # the dispatcher always lands on a valid kernel batch.
+        assert lanes & (lanes - 1) == 0
+
+
+def test_tile_plan_results_never_exceed_budgets():
+    cap = budget.max_dir_elems(1)
+    for lq in (9_000, 12_000, 19_000, 32_768, 48_000, 57_000,
+               100_000, 114_000):
+        plan = budget.tile_plan(lq, lq + 500)
+        assert plan is not None, lq
+        # Stitched dirs/nxt planes stay addressable by a flat int32
+        # index and under the 2 GB single-buffer ceiling.
+        assert plan.lanes * plan.Lq * plan.W <= cap
+        assert budget.vmem_est(plan.W, plan.T, plan.ch) <= budget.VMEM_BUDGET
+        # Padded length covers the read and divides exactly into tiles.
+        assert plan.Lq >= lq
+        assert plan.Lq % plan.T == 0
+        assert plan.n_tiles == plan.Lq // plan.T
+
+
+def test_tile_plan_tier_boundaries():
+    # ~9 kb (just past the untiled ceiling) still fits the 64-lane tier;
+    # 32 kb overflows its element cap (64 * 32768 * 1536 = 3.2e9) and
+    # drops to the 16-lane tier; ~100 kb needs the 8-lane T=4096 tier.
+    assert budget.tile_plan(9_000, 9_100).lanes == 64
+    assert budget.tile_plan(32_768, 33_000).lanes == 16
+    assert budget.tile_plan(100_000, 101_000).lanes == 8
+
+
+def test_tile_plan_rejects_untrackable_jobs():
+    # Past the last tier's element cap: no plan, caller goes native.
+    assert budget.tile_plan(130_000, 130_500) is None
+    # Length imbalance beyond W // 2 leaves no clearance for the band to
+    # hold both DP corners, even with re-centering.
+    assert budget.tile_plan(20_000, 24_000) is None
+    # Degenerate operands clamp to one tile instead of dividing by zero
+    # (the dispatcher screens empty jobs before planning anyway).
+    assert budget.tile_plan(0, 0).Lq == budget.TILE_TIERS[0][2]

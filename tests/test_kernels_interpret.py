@@ -17,8 +17,10 @@ import pytest
 import jax.numpy as jnp
 
 from racon_tpu.ops.flat import fw_dirs_xla
-from racon_tpu.ops.pallas.band_kernel import (band_geometry, fw_dirs_band,
-                                              fw_dirs_band_xla)
+from racon_tpu.ops.pallas.band_kernel import (UC_BOUNDARY, band_geometry,
+                                              fw_dirs_band, fw_dirs_band_xla,
+                                              fw_dirs_band_tile,
+                                              fw_dirs_band_xla_tile)
 from racon_tpu.ops.pallas.flat_kernel import fw_dirs_pallas
 
 M, X, G = 5, -4, -8
@@ -61,6 +63,47 @@ def test_band_kernel_interpret_matches_xla_twin(scoring):
     assert np.array_equal(np.transpose(np.asarray(ni), (0, 2, 1)),
                           np.asarray(nx))
     assert np.array_equal(np.asarray(hi), np.asarray(hx))
+
+
+@pytest.mark.parametrize("scoring", [(M, X, G), (0, -1, -1)])
+def test_tiled_band_kernel_interpret_matches_xla_twin(scoring):
+    """fw_dirs_band_tile(interpret=True) == fw_dirs_band_xla_tile on all
+    FIVE outputs (dirs, nxt, hlast, carried score frontier, carried
+    packed N/U/C frontier), for both the cold-start tile (i0=0, boundary
+    frontier) and a warm continuation tile (i0=T, frontier produced by
+    the twin) — modulo the [T, W, B] vs [T, B, W] layout transpose."""
+    m, x, g = scoring
+    rng = np.random.default_rng(13)
+    B, Lq, W, T = 8, 64, 128, 32
+    tband, qT, klo, lq = _band_inputs(rng, B=B, Lq=Lq, W=W)
+    klo_h = np.asarray(klo)
+    NEG = -(2 ** 30)
+    j0 = klo_h[:, None] + np.arange(W)[None, :]
+    prev = jnp.asarray(np.where(j0 >= 0, j0 * g, NEG).astype(np.int32))
+    uc = jnp.asarray(np.full((B, W), UC_BOUNDARY, np.int32))
+    hl = prev
+    for tile in range(2):
+        i0 = jnp.full((B,), tile * T, jnp.int32)
+        # Per-tile target window: rows [klo + i0, klo + i0 + W + T) of
+        # the per-lane diagonal band, same 7-fill as the dispatcher.
+        tb_t = jnp.asarray(tband[:, tile * T:tile * T + W + T])
+        q_t = jnp.asarray(qT[tile * T:(tile + 1) * T])
+        outs_i = fw_dirs_band_tile(tb_t, q_t, klo, jnp.asarray(lq), i0,
+                                   prev, uc, hl, match=m, mismatch=x,
+                                   gap=g, W=W, tb=B, ch=4, interpret=True)
+        outs_x = fw_dirs_band_xla_tile(tb_t, q_t, klo, jnp.asarray(lq), i0,
+                                       prev, uc, hl, match=m, mismatch=x,
+                                       gap=g, W=W)
+        di, ni, hi, pi, ui = [np.asarray(a) for a in outs_i]
+        dx, nx, hx, px, ux = [np.asarray(a) for a in outs_x]
+        assert np.array_equal(np.transpose(di, (0, 2, 1)), dx), tile
+        assert np.array_equal(np.transpose(ni, (0, 2, 1)), nx), tile
+        assert np.array_equal(hi, hx), tile
+        assert np.array_equal(pi, px), tile
+        assert np.array_equal(ui, ux), tile
+        # Carry the TWIN's frontier into the next tile so the warm tile
+        # exercises a realistic mid-read frontier on both paths.
+        hl, prev, uc = outs_x[2], outs_x[3], outs_x[4]
 
 
 def test_flat_kernel_interpret_matches_xla():

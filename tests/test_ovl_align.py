@@ -16,11 +16,14 @@ import pytest
 from racon_tpu.models.polisher import create_polisher, PolisherType
 
 
-def _write_dataset(tmp_path, n_reads=24, read_len=2400, seed=5):
-    """Tiny synthetic draft + reads + PAF with ~12% read-vs-draft error."""
+def _write_dataset(tmp_path, n_reads=24, read_len=2400, seed=5,
+                   rate=0.12, draft_len=40_000):
+    """Tiny synthetic draft + reads + PAF with ``rate`` read-vs-draft
+    error (default ~12%, ONT-class; the tiled ultralong tests use lower
+    rates with longer reads — see test_ovl_tiled.py)."""
     rng = np.random.default_rng(seed)
     bases = np.frombuffer(b"ACGT", np.uint8)
-    draft = bases[rng.integers(0, 4, 40_000)]
+    draft = bases[rng.integers(0, 4, draft_len)]
 
     def mutate(seq, rate):
         r = rng.random(len(seq))
@@ -42,7 +45,7 @@ def _write_dataset(tmp_path, n_reads=24, read_len=2400, seed=5):
     reads, paf = [], []
     for i in range(n_reads):
         t0 = int(rng.integers(0, len(draft) - read_len))
-        seg = mutate(draft[t0:t0 + read_len], 0.12)
+        seg = mutate(draft[t0:t0 + read_len], rate)
         strand = i % 3 == 1
         out = rc[seg][::-1] if strand else seg
         reads.append((f"r{i}", out.tobytes()))
