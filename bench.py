@@ -258,8 +258,16 @@ def main():
     extras = {**sched_extras, **e2e_transfers, **pipe_extras,
               **probe_extras, **adaptive_extras,
               **cache_extras(), **obs_metrics.resilience_extras(),
-              **obs_metrics.ovl_extras()}
+              **obs_metrics.ovl_extras(), **obs_metrics.dist_extras()}
     out = {
+        # metric_version 8: same primary value as versions 2-7 (the
+        # bench itself is single-process). New in 8: the dist_*
+        # distributed-ledger extras (claims / shards_stolen /
+        # lease_renewals / contigs_resumed / steal_latency_s ... from
+        # racon_tpu/distributed/) ride along — absent on a bench that
+        # never joined a work ledger, populated when the harness runs a
+        # sharded polish in-process, so a perf number produced while
+        # recovering stolen shards is visibly flagged.
         # metric_version 7: same primary value as versions 2-6 (the
         # consensus bench runs no overlap alignment, so the compute
         # rate is untouched). New in 7: the ovl_* extras ride along —
@@ -301,7 +309,7 @@ def main():
         # fixed_engine_windows_per_sec. Bump this whenever the primary
         # value's definition changes, so round-over-round comparisons
         # can't silently mix metrics.
-        "metric_version": 7,
+        "metric_version": 8,
         "metric": f"POA windows/sec/chip, compute-only (direct-timed warm "
                   f"production chunk, convergence-scheduled refinement "
                   f"rounds — racon_tpu/sched/, telemetry in sched_* "

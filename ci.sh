@@ -56,4 +56,7 @@ python scripts/pipeline_smoke.py
 echo "[ci] resilience smoke (injected faults + kill-and-resume byte-diff)"
 python scripts/resilience_smoke.py
 
+echo "[ci] preemption smoke (2-worker fleet, 3 evictions, steal + merge byte-diff)"
+python scripts/preemption_smoke.py
+
 echo "[ci] OK"

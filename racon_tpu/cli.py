@@ -105,6 +105,27 @@ def build_parser() -> argparse.ArgumentParser:
                          "contigs re-emit byte-identically from the "
                          "shard, only the rest recompute; refuses if "
                          "inputs or output-affecting options changed")
+    ap.add_argument("--ledger-dir", metavar="DIR", default=None,
+                    help="join (or start) the contig work ledger in "
+                         "DIR as one worker of a preemptible fleet: "
+                         "targets are sharded, leased, checkpointed "
+                         "per shard, and stolen from evicted workers; "
+                         "exactly one worker emits the merged FASTA "
+                         "(see docs/DISTRIBUTED.md)")
+    ap.add_argument("--workers", type=int, default=1, metavar="N",
+                    help="default: 1; fleet size hint for the ledger's "
+                         "shard partition (~2 shards per worker); only "
+                         "the first worker to publish the ledger "
+                         "decides")
+    ap.add_argument("--worker-id", metavar="ID", default=None,
+                    help="default: <hostname>-<pid>; stable identity "
+                         "for lease ownership and the events audit "
+                         "log")
+    ap.add_argument("--lease-s", type=float, default=30.0, metavar="S",
+                    help="default: 30.0; shard lease duration — an "
+                         "evicted worker's shard becomes stealable S "
+                         "seconds after its last renewal (each "
+                         "committed contig renews)")
     ap.add_argument("--version", action="store_true",
                     help="prints the version number")
     ap.add_argument("-h", "--help", action="store_true",
@@ -184,24 +205,38 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("[racon_tpu::] error: --resume requires --checkpoint-dir!",
               file=sys.stderr)
         return 1
+    if args.ledger_dir and (args.checkpoint_dir or args.resume):
+        print("[racon_tpu::] error: --ledger-dir manages per-shard "
+              "checkpoints itself; drop --checkpoint-dir/--resume!",
+              file=sys.stderr)
+        return 1
+    if args.ledger_dir and args.workers < 1:
+        print(f"[racon_tpu::] error: invalid --workers {args.workers}!",
+              file=sys.stderr)
+        return 1
+    if args.ledger_dir and args.lease_s <= 0:
+        print(f"[racon_tpu::] error: invalid --lease-s {args.lease_s}!",
+              file=sys.stderr)
+        return 1
+    # Everything that changes emitted bytes goes into the run
+    # fingerprint (checkpoint and ledger identity alike); backend /
+    # mesh / pipeline knobs are excluded because the execution paths
+    # are bit-identical by design.
+    ckpt_config = {
+        "version": __version__,
+        "include_unpolished": bool(args.include_unpolished),
+        "fragment_correction": bool(args.fragment_correction),
+        "window_length": args.window_length,
+        "quality_threshold": args.quality_threshold,
+        "error_threshold": args.error_threshold,
+        "match": args.match,
+        "mismatch": args.mismatch,
+        "gap": args.gap,
+    }
     if args.checkpoint_dir:
         from racon_tpu.resilience.checkpoint import (CheckpointError,
                                                      CheckpointStore,
                                                      run_fingerprint)
-        # Everything that changes emitted bytes goes into the
-        # fingerprint; backend/mesh/pipeline knobs are excluded because
-        # the execution paths are bit-identical by design.
-        ckpt_config = {
-            "version": __version__,
-            "include_unpolished": bool(args.include_unpolished),
-            "fragment_correction": bool(args.fragment_correction),
-            "window_length": args.window_length,
-            "quality_threshold": args.quality_threshold,
-            "error_threshold": args.error_threshold,
-            "match": args.match,
-            "mismatch": args.mismatch,
-            "gap": args.gap,
-        }
         try:
             fp = run_fingerprint(ckpt_config, args.paths[:3])
             store = (CheckpointStore.resume(args.checkpoint_dir, fp)
@@ -226,64 +261,94 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     from racon_tpu.obs.metrics import record_ckpt
     from racon_tpu.obs.metrics import registry as obs_registry
+    rc = 0
+
+    def make_polisher():
+        return create_polisher(
+            args.paths[0], args.paths[1], args.paths[2],
+            PolisherType.kF if args.fragment_correction
+            else PolisherType.kC,
+            args.window_length, args.quality_threshold,
+            args.error_threshold, args.match, args.mismatch, args.gap,
+            backend=args.backend, logger=logger, threads=args.threads,
+            mesh=mesh)
+
     try:
         with tracer.span("run", "racon_tpu"):
-            polisher = create_polisher(
-                args.paths[0], args.paths[1], args.paths[2],
-                PolisherType.kF if args.fragment_correction
-                else PolisherType.kC,
-                args.window_length, args.quality_threshold,
-                args.error_threshold, args.match, args.mismatch, args.gap,
-                backend=args.backend, logger=logger, threads=args.threads,
-                mesh=mesh)
-            polisher.initialize()
-            if store is not None and store.committed:
-                n_skip = polisher.skip_targets(store.committed)
-                if n_skip:
-                    print("[racon_tpu::] resume: skipping recompute of "
-                          f"{n_skip} window(s)", file=sys.stderr)
-            n_targets = polisher._targets_size
-            next_tid = 0
+            if args.ledger_dir:
+                from racon_tpu.distributed.worker import run_worker
+                from racon_tpu.io.parsers import create_sequence_parser
+                from racon_tpu.resilience.checkpoint import \
+                    run_fingerprint
+                fp = run_fingerprint(ckpt_config, args.paths[:3])
+                n_targets = len(
+                    create_sequence_parser(args.paths[2]).parse_all())
+                rc = run_worker(
+                    ledger_dir=args.ledger_dir, fingerprint=fp,
+                    n_targets=n_targets, worker_id=args.worker_id,
+                    workers=args.workers, lease_s=args.lease_s,
+                    make_polisher=make_polisher,
+                    drop_unpolished=not args.include_unpolished,
+                    out=out)
+            else:
+                polisher = make_polisher()
+                polisher.initialize()
+                if store is not None and store.committed:
+                    n_skip = polisher.skip_targets(store.committed)
+                    if n_skip:
+                        print("[racon_tpu::] resume: skipping "
+                              f"recompute of {n_skip} window(s)",
+                              file=sys.stderr)
+                n_targets = polisher._targets_size
+                next_tid = 0
 
-            def emit_stored(limit: int) -> None:
-                # Re-emit committed contigs (exact shard bytes) for
-                # every target slot before `limit` — interleaving
-                # stored and freshly polished targets in input order
-                # keeps resumed stdout byte-identical to a fresh run.
-                nonlocal next_tid
-                while next_tid < limit:
-                    if store is not None and \
-                            next_tid in store.committed:
-                        blob = store.read_emitted(next_tid)
-                        if blob is not None:
-                            out.write(blob)
-                        record_ckpt("skip", next_tid,
-                                    len(blob) if blob else 0)
-                    next_tid += 1
+                def emit_stored(limit: int) -> None:
+                    # Re-emit committed contigs (exact shard bytes)
+                    # for every target slot before `limit` —
+                    # interleaving stored and freshly polished targets
+                    # in input order keeps resumed stdout
+                    # byte-identical to a fresh run.
+                    nonlocal next_tid
+                    while next_tid < limit:
+                        if store is not None and \
+                                next_tid in store.committed:
+                            blob = store.read_emitted(next_tid)
+                            if blob is not None:
+                                out.write(blob)
+                            record_ckpt("skip", next_tid,
+                                        len(blob) if blob else 0)
+                        next_tid += 1
 
-            # Each contig is written the moment its last window
-            # retires (with the pipeline on, while later windows still
-            # flow through it — emission overlaps compute), then
-            # durably committed before the next one is handled.
-            for tid, rec in polisher.polish_records(
-                    not args.include_unpolished):
-                emit_stored(tid)
-                if rec is not None:
-                    out.write(b">" + rec.name.encode() + b"\n" +
-                              rec.data + b"\n")
-                if store is not None:
+                # Each contig is written the moment its last window
+                # retires (with the pipeline on, while later windows
+                # still flow through it — emission overlaps compute),
+                # then durably committed before the next one is
+                # handled.
+                for tid, rec in polisher.polish_records(
+                        not args.include_unpolished):
+                    emit_stored(tid)
                     if rec is not None:
-                        store.commit(tid, rec.name.encode(), rec.data)
-                    else:
-                        store.commit_dropped(tid)
-                next_tid = tid + 1
-            emit_stored(n_targets)
+                        out.write(b">" + rec.name.encode() + b"\n" +
+                                  rec.data + b"\n")
+                    if store is not None:
+                        if rec is not None:
+                            store.commit(tid, rec.name.encode(),
+                                         rec.data)
+                        else:
+                            store.commit_dropped(tid)
+                    next_tid = tid + 1
+                emit_stored(n_targets)
     except (PolisherError, ParseError, ValueError) as exc:
         print(str(exc), file=sys.stderr)
         return 1
     except _Interrupted as exc:
         out.flush()
-        if store is not None:
+        if args.ledger_dir:
+            print(f"[racon_tpu::] interrupted (signal {exc.signum}); "
+                  f"committed contigs are safe in {args.ledger_dir} — "
+                  "this worker's lease will expire and a survivor (or "
+                  "a rerun) will steal its shard", file=sys.stderr)
+        elif store is not None:
             print(f"[racon_tpu::] interrupted (signal {exc.signum}); "
                   f"{len(store.committed)} contig(s) committed in "
                   f"{args.checkpoint_dir} — rerun with --resume",
@@ -308,7 +373,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     for k, v in pipeline_extras(reg).items():
         reg.set(k, v)
     tracer.finish(metrics=reg.snapshot())
-    return 0
+    return rc
 
 
 if __name__ == "__main__":

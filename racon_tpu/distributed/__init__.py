@@ -1,0 +1,23 @@
+"""Preemption-tolerant sharded execution (docs/DISTRIBUTED.md).
+
+The polishing loop is embarrassingly parallel over contigs, so fleet
+scale-out is a *work distribution* problem, not a communication one:
+
+- ``ledger.py`` — the contig work ledger: partitions the target set
+  into shards, hands them to workers under time-bounded leases, and
+  lets survivors steal shards whose lease expired;
+- ``worker.py`` — the worker loop: claim → polish through the existing
+  engine (``Polisher.polish_records``) with a per-shard checkpoint
+  store → complete; plus the merge phase that assembles shard FASTAs
+  in target order, byte-identical to the serial path.
+
+Everything lives on a shared filesystem (or one host's disk for
+multi-process runs); there is no coordinator process and no network
+protocol — an evicted worker is simply a lease that stops being
+renewed.
+"""
+
+from racon_tpu.distributed.ledger import (Claim, LeaseLost, LedgerError,
+                                          WorkLedger)
+
+__all__ = ["Claim", "LeaseLost", "LedgerError", "WorkLedger"]

@@ -1,0 +1,402 @@
+"""Contig work ledger: shards, leases, stealing, ordered merge.
+
+The ledger is a directory on a filesystem every worker can reach::
+
+    <ledger-dir>/
+      meta.json          run identity + shard partition (published once,
+                         atomically — publish_exclusive)
+      events.jsonl       append-only audit log (claims/steals/completes)
+      shard_<k>.lease    {"name", "worker", "epoch", "nonce", "deadline"}
+      shard_<k>.done     completion marker (lease-fenced write)
+      shard_<k>/         that shard's CheckpointStore (meta.json,
+                         contigs.fasta, manifest.jsonl)
+      merge.lease        the merge phase is itself a stealable
+      merge.done         pseudo-shard, so a worker evicted mid-merge
+      out.fasta          doesn't strand the run
+
+There is no coordinator. Liveness is a **time-bounded lease**: a worker
+claims a shard by publishing its lease file, renews the deadline as it
+polishes, and any survivor may rewrite an *expired* lease to steal the
+shard. Mutual exclusion is best-effort (two workers can transiently
+hold the same shard across a steal race or a paused-then-resumed
+victim); correctness never depends on it:
+
+- compute is deterministic, and commits land in the shard's own
+  append-only checkpoint store — a duplicate commit re-appends the
+  same bytes and the manifest's last record wins, so the merged output
+  is unchanged;
+- the **nonce is the fence**: every renew/complete re-reads the lease
+  and raises :class:`LeaseLost` when its nonce is gone, so a stale
+  worker stops promptly instead of finishing a stolen shard;
+- ``meta.json`` is immutable after publication and carries the run
+  fingerprint, so two differently-configured runs can never share a
+  ledger (same refusal discipline as resilience/checkpoint.py).
+
+Steals verify their write won by re-reading the lease and comparing
+nonces — with rename-atomic lease files, the last writer wins and every
+loser observes a foreign nonce. Lease clocks honor ``clock_skew()``
+(the ``skew=`` fault clause), so expiry is provable in tier-1 without
+wall-clock waits.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from racon_tpu.obs.metrics import record_dist
+from racon_tpu.resilience import checkpoint as ckpt
+from racon_tpu.resilience.faults import clock_skew, maybe_fault
+from racon_tpu.utils.atomicio import (append_fsync, atomic_finalize,
+                                      atomic_write_bytes,
+                                      publish_exclusive)
+
+SCHEMA = 1
+META_NAME = "meta.json"
+EVENTS_NAME = "events.jsonl"
+MERGE_NAME = "merge"
+OUT_NAME = "out.fasta"
+ENV_SHARDS = "RACON_TPU_DIST_SHARDS"
+
+
+class LedgerError(ValueError):
+    """Unusable ledger: fingerprint/schema mismatch, corrupt metadata,
+    or a done shard whose store doesn't cover its target range. A hard
+    error — silently recomputing would mask operator mistakes."""
+
+
+class LeaseLost(RuntimeError):
+    """This worker's lease was stolen (its nonce is gone). The holder
+    must abandon the shard immediately; the thief owns it now."""
+
+    def __init__(self, name: str, worker: str):
+        super().__init__(
+            f"[racon_tpu::dist] worker {worker} lost its lease on "
+            f"{name} — shard was stolen after lease expiry")
+        self.name = name
+
+
+class Claim:
+    """A held lease. ``shard`` is the shard index (-1 for the merge
+    pseudo-shard); ``stolen`` records whether this claim evicted a
+    previous holder (its committed prefix will be resumed)."""
+
+    __slots__ = ("name", "shard", "worker", "epoch", "nonce", "stolen",
+                 "deadline")
+
+    def __init__(self, name: str, shard: int, worker: str, epoch: int,
+                 nonce: str, stolen: bool, deadline: float):
+        self.name = name
+        self.shard = shard
+        self.worker = worker
+        self.epoch = epoch
+        self.nonce = nonce
+        self.stolen = stolen
+        self.deadline = deadline
+
+
+def _partition(n_targets: int, n_shards: int) -> List[int]:
+    """Contiguous balanced partition bounds: shard k owns targets
+    [bounds[k], bounds[k+1]). Contiguity keeps each shard's checkpoint
+    manifest a prefix of an input-order walk — the same invariant the
+    serial resume path relies on."""
+    base, extra = divmod(n_targets, n_shards)
+    bounds = [0]
+    for k in range(n_shards):
+        bounds.append(bounds[-1] + base + (1 if k < extra else 0))
+    return bounds
+
+
+class WorkLedger:
+    def __init__(self, directory: str, meta: Dict):
+        self.directory = directory
+        self.meta = meta
+        self.fingerprint: str = meta["fingerprint"]
+        self.bounds: List[int] = [int(b) for b in meta["bounds"]]
+        self.n_shards: int = len(self.bounds) - 1
+        self.n_targets: int = int(meta["n_targets"])
+        self.lease_s: float = float(meta["lease_s"])
+
+    # ------------------------------------------------------- open
+    @classmethod
+    def open(cls, directory: str, fingerprint: str, *,
+             n_targets: int, workers: int = 1, lease_s: float = 30.0,
+             n_shards: Optional[int] = None) -> "WorkLedger":
+        """Open (publishing if first) the ledger for this run.
+
+        Every worker calls this with its own view of the run identity;
+        whoever gets here first publishes ``meta.json`` atomically and
+        everyone else adopts the published partition — so all workers
+        agree on shard bounds and lease duration even if their CLI
+        flags disagree.
+        """
+        if n_targets < 1:
+            raise LedgerError(
+                "[racon_tpu::dist] refusing to open a ledger for an "
+                "empty target set")
+        if n_shards is None:
+            env = os.environ.get(ENV_SHARDS, "")
+            if env:
+                n_shards = int(env)
+            else:
+                # Over-partition ~2x the fleet so a steal transfers a
+                # shard's worth of work, not half the run.
+                n_shards = max(1, int(workers) * 2)
+        n_shards = max(1, min(int(n_shards), n_targets))
+        os.makedirs(directory, exist_ok=True)
+        meta = {
+            "schema": SCHEMA,
+            "fingerprint": fingerprint,
+            "n_targets": int(n_targets),
+            "bounds": _partition(n_targets, n_shards),
+            "lease_s": float(lease_s),
+            "workers": int(workers),
+        }
+        path = os.path.join(directory, META_NAME)
+        blob = (json.dumps(meta, sort_keys=True) + "\n").encode()
+        publish_exclusive(path, blob)
+        # Winner or not, the published file is the contract.
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                published = json.load(fh)
+        except (OSError, ValueError) as exc:
+            raise LedgerError(
+                f"[racon_tpu::dist] unreadable ledger {META_NAME} in "
+                f"{directory!r} ({exc})") from exc
+        if published.get("schema") != SCHEMA:
+            raise LedgerError(
+                f"[racon_tpu::dist] ledger schema "
+                f"{published.get('schema')!r} != {SCHEMA}")
+        if published.get("fingerprint") != fingerprint:
+            raise LedgerError(
+                "[racon_tpu::dist] refusing to join ledger "
+                f"{directory!r}: its fingerprint does not match this "
+                "run — inputs or output-affecting options changed")
+        if published.get("n_targets") != n_targets:
+            raise LedgerError(
+                f"[racon_tpu::dist] ledger target count "
+                f"{published.get('n_targets')!r} != {n_targets} seen "
+                "by this worker")
+        return cls(directory, published)
+
+    # ------------------------------------------------------ layout
+    def shard_range(self, k: int) -> Tuple[int, int]:
+        return self.bounds[k], self.bounds[k + 1]
+
+    def shard_ckpt_dir(self, k: int) -> str:
+        return os.path.join(self.directory, f"shard_{k}")
+
+    def shard_fp(self, k: int) -> str:
+        return ckpt.shard_fingerprint(self.fingerprint, k)
+
+    @property
+    def out_path(self) -> str:
+        return os.path.join(self.directory, OUT_NAME)
+
+    def _lease_path(self, name: str) -> str:
+        return os.path.join(self.directory, f"{name}.lease")
+
+    def _done_path(self, name: str) -> str:
+        return os.path.join(self.directory, f"{name}.done")
+
+    def _now(self) -> float:
+        return time.time() + clock_skew()
+
+    # ------------------------------------------------------ events
+    def _event(self, rec: Dict) -> None:
+        rec = dict(rec, t=round(time.time(), 3))
+        data = (json.dumps(rec, sort_keys=True) + "\n").encode()
+        # O_APPEND: concurrent single-write appends from multiple
+        # workers interleave whole records. Advisory, so best-effort.
+        try:
+            with open(os.path.join(self.directory, EVENTS_NAME),
+                      "ab") as fh:
+                append_fsync(fh, data)
+        except OSError:
+            pass
+
+    def events(self) -> List[Dict]:
+        from racon_tpu.utils.atomicio import load_jsonl_prefix
+        path = os.path.join(self.directory, EVENTS_NAME)
+        if not os.path.exists(path):
+            return []
+        records, _ = load_jsonl_prefix(path)
+        return records
+
+    # ------------------------------------------------------ leases
+    def _read_lease(self, name: str) -> Optional[Dict]:
+        """None when absent, unreadable, or torn — an unreadable lease
+        is treated as expired (its writer crashed mid-publish; nothing
+        can renew it)."""
+        try:
+            with open(self._lease_path(name), "rb") as fh:
+                rec = json.loads(fh.read())
+            if not isinstance(rec, dict):
+                return None
+            return rec
+        except (OSError, ValueError):
+            return None
+
+    def is_done(self, name: str) -> bool:
+        return os.path.exists(self._done_path(name))
+
+    def _try_claim(self, name: str, shard: int,
+                   worker: str) -> Optional[Claim]:
+        """Claim ``name`` if unclaimed, or steal it if its lease
+        expired. Returns None when someone else holds a live lease (or
+        won the race)."""
+        if self.is_done(name):
+            return None
+        maybe_fault("dist/claim")
+        path = self._lease_path(name)
+        nonce = os.urandom(8).hex()
+        now = self._now()
+        lease = {"name": name, "worker": worker, "epoch": 1,
+                 "nonce": nonce, "deadline": now + self.lease_s}
+        if not os.path.exists(path):
+            blob = (json.dumps(lease, sort_keys=True) + "\n").encode()
+            if publish_exclusive(path, blob):
+                self._event({"ev": "claim", "name": name,
+                             "worker": worker, "epoch": 1})
+                record_dist("claims" if shard >= 0 else "merge_claims",
+                            shard, worker)
+                return Claim(name, shard, worker, 1, nonce, False,
+                             lease["deadline"])
+            # Lost the first-claim race; fall through and look at what
+            # the winner published.
+        cur = self._read_lease(name)
+        if cur is not None and float(cur.get("deadline", 0.0)) > now:
+            return None  # live lease — not ours to touch
+        # Expired (or torn) lease: steal by rewriting it, then verify
+        # our write survived — concurrent stealers race on the rename
+        # and every loser sees a foreign nonce on re-read.
+        epoch = int(cur.get("epoch", 0)) + 1 if cur else 1
+        expired_for = max(0.0, now - float(cur.get("deadline", now))) \
+            if cur else 0.0
+        victim = cur.get("worker", "?") if cur else "?"
+        lease["epoch"] = epoch
+        lease["deadline"] = self._now() + self.lease_s
+        atomic_write_bytes(path, (json.dumps(
+            lease, sort_keys=True) + "\n").encode())
+        back = self._read_lease(name)
+        if back is None or back.get("nonce") != nonce:
+            return None  # another stealer's rename landed after ours
+        if shard >= 0:
+            record_dist("leases_expired", shard, worker)
+            record_dist("shards_stolen", shard, worker, epoch=epoch)
+            record_dist("steal_latency_s", shard, worker,
+                        value=expired_for)
+        else:
+            record_dist("merge_steals", shard, worker, epoch=epoch)
+        self._event({"ev": "steal", "name": name, "worker": worker,
+                     "victim": victim, "epoch": epoch,
+                     "expired_for_s": round(expired_for, 3)})
+        return Claim(name, shard, worker, epoch, nonce, True,
+                     lease["deadline"])
+
+    def claim_shard(self, worker: str) -> Optional[Claim]:
+        """The next shard this worker can own, scanning in index order
+        (earliest incomplete work first, which also keeps the merge's
+        wait roughly FIFO). None when every shard is done or
+        live-leased elsewhere."""
+        for k in range(self.n_shards):
+            claim = self._try_claim(f"shard_{k}", k, worker)
+            if claim is not None:
+                return claim
+        return None
+
+    def claim_merge(self, worker: str) -> Optional[Claim]:
+        return self._try_claim(MERGE_NAME, -1, worker)
+
+    def verify(self, claim: Claim) -> None:
+        """Fencing check: raise LeaseLost unless ``claim``'s nonce is
+        still the one on disk."""
+        cur = self._read_lease(claim.name)
+        if cur is None or cur.get("nonce") != claim.nonce:
+            record_dist("leases_lost", claim.shard, claim.worker)
+            raise LeaseLost(claim.name, claim.worker)
+
+    def renew(self, claim: Claim) -> None:
+        """Push the deadline out; raises LeaseLost if stolen. Renewing
+        an expired-but-unstolen lease succeeds — expiry only matters
+        if a thief acted on it."""
+        self.verify(claim)
+        lease = {"name": claim.name, "worker": claim.worker,
+                 "epoch": claim.epoch, "nonce": claim.nonce,
+                 "deadline": self._now() + self.lease_s}
+        atomic_write_bytes(self._lease_path(claim.name), (json.dumps(
+            lease, sort_keys=True) + "\n").encode())
+        claim.deadline = lease["deadline"]
+        record_dist("lease_renewals", claim.shard, claim.worker)
+
+    def complete(self, claim: Claim, **info) -> None:
+        """Publish the done marker, fenced by a final verify so a stale
+        worker can't mark a shard done with a thief mid-recompute."""
+        self.verify(claim)
+        rec = {"name": claim.name, "worker": claim.worker,
+               "epoch": claim.epoch}
+        rec.update(info)
+        atomic_write_bytes(self._done_path(claim.name), (json.dumps(
+            rec, sort_keys=True) + "\n").encode())
+        self._event(dict(rec, ev="complete"))
+
+    def shards_done(self) -> bool:
+        return all(self.is_done(f"shard_{k}")
+                   for k in range(self.n_shards))
+
+    def pending_shards(self) -> List[int]:
+        return [k for k in range(self.n_shards)
+                if not self.is_done(f"shard_{k}")]
+
+    def merge_done(self) -> bool:
+        return self.is_done(MERGE_NAME) and os.path.exists(
+            self.out_path)
+
+    # ------------------------------------------------------- merge
+    def iter_merged(self) -> Iterator[Tuple[int, Optional[bytes]]]:
+        """Yield ``(tid, blob-or-None)`` in target input order across
+        all shard stores — the exact bytes each shard committed, so
+        concatenation is byte-identical to the serial path. Requires
+        every shard done."""
+        for k in range(self.n_shards):
+            start, end = self.shard_range(k)
+            if start == end:
+                continue
+            store = ckpt.CheckpointStore.resume(self.shard_ckpt_dir(k),
+                                                self.shard_fp(k))
+            try:
+                for tid in range(start, end):
+                    if tid not in store.committed:
+                        raise LedgerError(
+                            f"[racon_tpu::dist] shard {k} is marked "
+                            f"done but target {tid} has no committed "
+                            "record — ledger corrupt")
+                    yield tid, store.read_emitted(tid)
+            finally:
+                store.close()
+
+    def merge(self) -> Tuple[int, int]:
+        """Assemble ``out.fasta`` from the shard stores (caller holds
+        the merge claim). Returns ``(bytes, contigs_emitted)``. Written
+        via tmp + fsync + atomic finalize, so a worker evicted
+        mid-merge leaves no partial output and its thief redoes the
+        whole (cheap, read-only) pass."""
+        if not self.shards_done():
+            raise LedgerError(
+                "[racon_tpu::dist] merge requested with shards still "
+                f"pending: {self.pending_shards()}")
+        tmp = f"{self.out_path}.tmp.{os.getpid()}"
+        total = emitted = 0
+        with open(tmp, "wb") as fh:
+            for _tid, blob in self.iter_merged():
+                if blob is None:
+                    continue
+                fh.write(blob)
+                total += len(blob)
+                emitted += 1
+            fh.flush()
+            os.fsync(fh.fileno())
+        atomic_finalize(tmp, self.out_path)
+        return total, emitted

@@ -1,0 +1,202 @@
+"""The distributed worker loop: claim → polish → complete → merge.
+
+One ``racon_tpu --ledger-dir`` invocation is one worker. Workers share
+nothing but the ledger directory; each runs the full single-process
+engine (``Polisher.polish_records``) restricted to its claimed shard's
+target range, committing every finished contig into that shard's
+checkpoint store before renewing the lease. Eviction at any instruction
+is recoverable:
+
+- mid-contig: the store's committed prefix survives; the thief resumes
+  it (``CheckpointStore.resume`` + ``skip_targets``) and recomputes
+  only the in-flight contig;
+- mid-commit: crash-consistency ordering (shard bytes fsync'd before
+  the manifest record, torn manifest tails dropped on resume) means
+  the thief sees either the whole contig or none of it;
+- mid-merge: the merge is a lease-fenced pseudo-shard writing through
+  tmp+rename — a dead merger's thief redoes the cheap read-only pass.
+
+Fault sites: ``dist/shard`` fires once per claimed shard (before any
+polishing), ``dist/contig`` once per retired contig (before its
+commit), ``dist/claim`` per claim attempt, ``dist/merge`` before the
+merge pass — so eviction drills can target any phase deterministically.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import Callable, Optional
+
+from racon_tpu.distributed.ledger import Claim, LeaseLost, WorkLedger
+from racon_tpu.obs.metrics import record_dist, set_dist
+from racon_tpu.resilience import checkpoint as ckpt
+from racon_tpu.resilience.faults import maybe_fault
+
+ENV_POLL = "RACON_TPU_DIST_POLL"
+
+
+def default_worker_id() -> str:
+    import socket
+    return f"{socket.gethostname()}-{os.getpid()}"
+
+
+def _poll_interval(lease_s: float) -> float:
+    env = os.environ.get(ENV_POLL, "")
+    if env:
+        return max(0.01, float(env))
+    # Often enough to steal promptly after expiry, rare enough that an
+    # idle fleet doesn't hammer the shared filesystem.
+    return min(1.0, max(0.05, lease_s / 10.0))
+
+
+def _open_store(ledger: WorkLedger, k: int) -> ckpt.CheckpointStore:
+    d = ledger.shard_ckpt_dir(k)
+    fp = ledger.shard_fp(k)
+    if os.path.exists(os.path.join(d, ckpt.META_NAME)):
+        return ckpt.CheckpointStore.resume(d, fp)
+    return ckpt.CheckpointStore.create(d, fp)
+
+
+def _polish_shard(ledger: WorkLedger, claim: Claim,
+                  make_polisher: Callable,
+                  drop_unpolished: bool, log) -> int:
+    """Polish one claimed shard to completion; returns the number of
+    committed targets. Raises LeaseLost the moment the lease is
+    observed stolen."""
+    k = claim.shard
+    start, end = ledger.shard_range(k)
+    store = _open_store(ledger, k)
+    try:
+        if store.committed:
+            # A stolen (or re-claimed) shard: everything the victim
+            # committed re-emits from its store, zero recompute.
+            record_dist("contigs_resumed", k, claim.worker,
+                        value=len(store.committed))
+            print(f"[racon_tpu::dist] worker {claim.worker}: shard "
+                  f"{k} resumes {len(store.committed)}/{end - start} "
+                  "committed contig(s) from previous holder",
+                  file=log)
+        if len(store.committed) < end - start:
+            polisher = make_polisher()
+            polisher.initialize()
+            polisher.restrict_targets(range(start, end))
+            if store.committed:
+                polisher.skip_targets(store.committed)
+            for tid, rec in polisher.polish_records(drop_unpolished):
+                maybe_fault("dist/contig")
+                ledger.renew(claim)
+                if rec is not None:
+                    store.commit(tid, rec.name.encode(), rec.data)
+                else:
+                    store.commit_dropped(tid)
+                record_dist("contigs_polished", k, claim.worker,
+                            tid=tid)
+                if claim.stolen:
+                    record_dist("contigs_repolished", k, claim.worker,
+                                tid=tid)
+        # Targets with zero windows never reach the assembler, so they
+        # yield nothing above — commit them as drops explicitly so the
+        # done marker really means "every tid in range accounted for".
+        for tid in range(start, end):
+            if tid not in store.committed:
+                ledger.renew(claim)
+                store.commit_dropped(tid)
+        return len(store.committed)
+    finally:
+        store.close()
+
+
+def _merge_phase(ledger: WorkLedger, worker: str, out, log,
+                 poll: float) -> int:
+    """Every worker races for the merge pseudo-shard; exactly one wins
+    and emits the merged FASTA. Losers wait for the done marker so the
+    process exit means the run's output exists."""
+    import shutil
+    while True:
+        if ledger.merge_done():
+            print(f"[racon_tpu::dist] worker {worker}: merged output "
+                  f"already published by another worker "
+                  f"({ledger.out_path})", file=log)
+            return 0
+        claim = ledger.claim_merge(worker)
+        if claim is None:
+            time.sleep(poll)
+            continue
+        maybe_fault("dist/merge")
+        try:
+            nbytes, emitted = ledger.merge()
+            ledger.complete(claim, n_bytes=nbytes,
+                            contigs_emitted=emitted)
+        except LeaseLost:
+            print(f"[racon_tpu::dist] worker {worker}: lost the merge "
+                  "lease mid-pass — retrying against the thief's "
+                  "result", file=log)
+            continue
+        record_dist("merges", -1, worker, bytes=nbytes)
+        with open(ledger.out_path, "rb") as fh:
+            shutil.copyfileobj(fh, out)
+        out.flush()
+        print(f"[racon_tpu::dist] worker {worker}: merged "
+              f"{emitted} contig(s), {nbytes} bytes, from "
+              f"{ledger.n_shards} shard(s)", file=log)
+        return 0
+
+
+def run_worker(*, ledger_dir: str, fingerprint: str, n_targets: int,
+               worker_id: Optional[str], workers: int, lease_s: float,
+               make_polisher: Callable, drop_unpolished: bool,
+               out=None, log=None) -> int:
+    """Drive one worker from fleet join to merged output.
+
+    ``make_polisher`` builds a fresh (uninitialized) Polisher — one per
+    claimed shard, since windows are pruned destructively. Returns a
+    process exit code; crashes (injected or real) propagate so the
+    process dies exactly as a preempted worker would.
+    """
+    out = out if out is not None else sys.stdout.buffer
+    log = log if log is not None else sys.stderr
+    worker = worker_id or default_worker_id()
+    ledger = WorkLedger.open(ledger_dir, fingerprint,
+                             n_targets=n_targets, workers=workers,
+                             lease_s=lease_s)
+    set_dist("workers", int(workers))
+    set_dist("shards", ledger.n_shards)
+    set_dist("n_targets", ledger.n_targets)
+    poll = _poll_interval(ledger.lease_s)
+    print(f"[racon_tpu::dist] worker {worker}: joined ledger "
+          f"{ledger_dir} ({ledger.n_targets} target(s) in "
+          f"{ledger.n_shards} shard(s), lease {ledger.lease_s:g}s)",
+          file=log)
+
+    while not ledger.shards_done():
+        claim = ledger.claim_shard(worker)
+        if claim is None:
+            # Everything is live-leased elsewhere: wait for a
+            # completion or an expiry to steal.
+            time.sleep(poll)
+            continue
+        maybe_fault("dist/shard")
+        t0 = time.perf_counter()
+        try:
+            n = _polish_shard(ledger, claim, make_polisher,
+                              drop_unpolished, log)
+            ledger.complete(claim, n_committed=n)
+        except LeaseLost:
+            # The shard was stolen while we held it (our own lease
+            # expired — e.g. a long pause). The thief owns the work
+            # now; our commits so far are still valid prefix for it.
+            print(f"[racon_tpu::dist] worker {worker}: abandoning "
+                  f"shard {claim.shard} — lease stolen while working",
+                  file=log)
+            continue
+        record_dist("shards_completed", claim.shard, worker)
+        if claim.stolen:
+            record_dist("recovery_wall_s", claim.shard, worker,
+                        value=time.perf_counter() - t0)
+        print(f"[racon_tpu::dist] worker {worker}: shard "
+              f"{claim.shard} complete ({n} target(s))"
+              f"{' [stolen]' if claim.stolen else ''}", file=log)
+
+    return _merge_phase(ledger, worker, out, log, poll)

@@ -352,6 +352,21 @@ class Polisher:
         self.windows = keep
         return n
 
+    def restrict_targets(self, keep) -> int:
+        """Drop every window NOT belonging to the given target ids —
+        the distributed-shard path (racon_tpu/distributed/): a worker
+        holding a work-ledger shard polishes only that shard's contigs
+        while parsing the same input files as everyone else. Pruning
+        whole targets is safe for the assembler by the same argument as
+        :meth:`skip_targets` (each contig's windows restart at rank 0).
+        Returns #windows dropped.
+        """
+        keep = set(keep)
+        kept = [w for w in self.windows if w.id in keep]
+        n = len(self.windows) - len(kept)
+        self.windows = kept
+        return n
+
     def polish_records(self, drop_unpolished_sequences: bool = True):
         """The one polishing loop: yield ``(target_id, record-or-None)``
         as each target's last window finalizes, in target input order.

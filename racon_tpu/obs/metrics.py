@@ -234,6 +234,46 @@ def resilience_extras(reg: Optional[MetricsRegistry] = None
     return out
 
 
+# ----------------------------------------------------- distributed work
+
+def record_dist(event: str, shard, worker, value: float = 1,
+                reg: Optional[MetricsRegistry] = None, **attrs) -> None:
+    """Account one distributed-ledger event (racon_tpu/distributed/):
+    ``claims`` / ``shards_stolen`` / ``leases_expired`` /
+    ``lease_renewals`` / ``leases_lost`` / ``contigs_polished`` /
+    ``contigs_repolished`` / ``contigs_resumed`` /
+    ``shards_completed`` / ``steal_latency_s`` / ``recovery_wall_s`` /
+    ``merges`` — each lands as the counter
+    ``dist_<event>`` (incremented by ``value``) plus a ``dist`` trace
+    span carrying the shard id and worker identity. ``shard`` is -1 for
+    run-level events (merge)."""
+    reg = reg if reg is not None else _REGISTRY
+    reg.inc(f"dist_{event}", value)
+    _trace.get_tracer().point("dist", event, shard=int(shard),
+                              worker=str(worker), **attrs)
+
+
+def set_dist(key: str, value: object,
+             reg: Optional[MetricsRegistry] = None) -> None:
+    """Set a distributed gauge (``dist_workers``, ``dist_shards``,
+    ``dist_n_targets`` — fleet shape, not counters)."""
+    reg = reg if reg is not None else _REGISTRY
+    reg.set(f"dist_{key}", value)
+
+
+def dist_extras(reg: Optional[MetricsRegistry] = None
+                ) -> Dict[str, object]:
+    """The registry's dist_* keys as a JSON-ready dict (bench extras
+    metric_version 8 / obs_report "Distributed" section). Empty when no
+    ledger ran, so single-process runs stay quiet."""
+    reg = reg if reg is not None else _REGISTRY
+    out: Dict[str, object] = {}
+    for k, v in sorted(reg.snapshot().items()):
+        if k.startswith("dist_"):
+            out[k] = round(v, 4) if isinstance(v, float) else v
+    return out
+
+
 # ------------------------------------------- overlap-alignment counters
 
 def record_ovl(device_jobs: int, native_jobs: int, tiles: int,

@@ -27,14 +27,28 @@ run. The store keeps three files in ``--checkpoint-dir``:
 
 Crash consistency is ordering, not locking: the shard append is fsync'd
 **before** its manifest record is appended (also fsync'd), so a
-manifest record always points at durable shard bytes. On resume the
-store takes the longest valid manifest prefix (a torn tail line is
-dropped and the manifest rewritten atomically), then truncates the
-shard to the last referenced byte — orphaned shard bytes from a crash
-between the two appends are discarded and that contig recomputes.
+manifest record always points at durable shard bytes. The first append
+after creating the store also fsyncs the *directory* — file fsync
+alone does not make a fresh file's directory entry durable, so without
+it a power loss could erase the whole store, committed contigs
+included. On resume the store takes the longest valid manifest prefix
+(a torn tail line — a partially-written final record — is dropped and
+the manifest rewritten atomically), then truncates the shard to the
+last referenced byte — orphaned shard bytes from a crash between the
+two appends are discarded and that contig recomputes.
 
-Commits pass through the ``ckpt/commit`` fault site, so the
-kill-mid-commit scenario (scripts/resilience_smoke.py) is reproducible.
+Commits pass through the ``ckpt/commit`` fault site (before the shard
+append) and the ``ckpt/manifest`` site (between the shard and manifest
+appends — the mid-commit eviction window; a ``torn`` action there
+writes half the manifest record and hard-exits), so the kill-mid-commit
+and torn-manifest scenarios (scripts/resilience_smoke.py,
+scripts/preemption_smoke.py) are reproducible.
+
+Shard fingerprints: the distributed layer (racon_tpu/distributed/)
+opens one store per work-ledger shard under
+``shard_fingerprint = sha256(run_fingerprint + shard id)``, so a
+stolen shard resumes from its victim's committed prefix but a store
+can never be spliced into the wrong shard or run.
 """
 
 from __future__ import annotations
@@ -45,7 +59,7 @@ import os
 from typing import Dict, IO, Iterable, Optional
 
 from racon_tpu.utils.atomicio import (append_fsync, atomic_write_text,
-                                      fsync_dir)
+                                      fsync_dir, load_jsonl_prefix)
 
 SCHEMA = 1
 META_NAME = "meta.json"
@@ -86,6 +100,13 @@ def run_fingerprint(config: Dict, paths: Iterable[str]) -> str:
     return hashlib.sha256(blob.encode()).hexdigest()
 
 
+def shard_fingerprint(run_fp: str, shard: int) -> str:
+    """Fingerprint of one work-ledger shard: the run identity plus the
+    shard id, so per-shard stores are mutually unspliceable."""
+    return hashlib.sha256(f"{run_fp}:shard:{int(shard)}"
+                          .encode()).hexdigest()
+
+
 class CheckpointStore:
     """Append-only contig store bound to one run fingerprint.
 
@@ -100,6 +121,9 @@ class CheckpointStore:
         self.committed: Dict[int, Dict] = {}
         self._shard: Optional[IO[bytes]] = None
         self._manifest: Optional[IO[bytes]] = None
+        # The first commit after open fsyncs the directory so the
+        # shard/manifest *entries* are durable, not just their bytes.
+        self._dir_synced = False
 
     # -------------------------------------------------- construction
     @property
@@ -131,7 +155,8 @@ class CheckpointStore:
         header = {"ev": "begin", "schema": SCHEMA,
                   "fingerprint": fingerprint}
         append_fsync(store._manifest, (json.dumps(
-            header, sort_keys=True) + "\n").encode())
+            header, sort_keys=True) + "\n").encode(),
+            sync_dir=directory)
         return store
 
     @classmethod
@@ -160,34 +185,28 @@ class CheckpointStore:
         return store
 
     def _recover(self) -> None:
-        """Longest-valid-prefix manifest recovery + shard truncation."""
-        records = []
-        torn = False
+        """Longest-valid-prefix manifest recovery + shard truncation.
+
+        Tolerates a final partially-written JSONL line (a torn append
+        from a mid-commit crash) by truncating to the last valid
+        record instead of raising — the shared
+        ``atomicio.load_jsonl_prefix`` discipline."""
+        def _check(rec):
+            if rec.get("ev") == "contig":
+                if "offset" in rec:
+                    _ = (int(rec["tid"]), int(rec["offset"]),
+                         int(rec["length"]), rec["name"])
+                else:
+                    _ = (int(rec["tid"]), rec["emitted"])
+
         try:
-            with open(self.manifest_path, "rb") as fh:
-                raw = fh.read()
+            records, clean = load_jsonl_prefix(self.manifest_path,
+                                               validate=_check)
         except OSError as exc:
             raise CheckpointError(
                 f"[racon_tpu::checkpoint] cannot resume: unreadable "
                 f"{MANIFEST_NAME} ({exc})") from exc
-        lines = raw.split(b"\n")
-        # A well-formed file ends with a newline → last split is empty;
-        # anything after the final newline is a torn tail by definition.
-        if lines and lines[-1] != b"":
-            torn = True
-        for line in lines[:-1] if lines else []:
-            try:
-                rec = json.loads(line)
-                if rec.get("ev") == "contig":
-                    if "offset" in rec:
-                        _ = (int(rec["tid"]), int(rec["offset"]),
-                             int(rec["length"]), rec["name"])
-                    else:
-                        _ = (int(rec["tid"]), rec["emitted"])
-            except (ValueError, KeyError, TypeError):
-                torn = True
-                break
-            records.append(rec)
+        torn = not clean
         if not records or records[0].get("ev") != "begin":
             raise CheckpointError(
                 f"[racon_tpu::checkpoint] cannot resume: "
@@ -238,11 +257,29 @@ class CheckpointStore:
         self._manifest = open(self.manifest_path, "ab")
 
     # ---------------------------------------------------- operations
+    def _append_manifest(self, rec: Dict) -> None:
+        """The committing write. ``ckpt/manifest`` is the mid-commit
+        eviction window (after the shard append, before this one); a
+        ``torn`` fault there makes half the record durable and
+        hard-exits — exactly the partially-written final line
+        :func:`_recover` must drop."""
+        from racon_tpu.resilience.faults import hard_exit, maybe_torn
+        data = (json.dumps(rec, sort_keys=True) + "\n").encode()
+        sync = None if self._dir_synced else self.directory
+        if maybe_torn("ckpt/manifest"):
+            append_fsync(self._manifest, data[:max(1, len(data) // 2)],
+                         sync_dir=sync)
+            hard_exit(137)
+        append_fsync(self._manifest, data, sync_dir=sync)
+        self._dir_synced = True
+
     def commit(self, tid: int, name: bytes, data: bytes) -> None:
         """Durably store target ``tid``'s emitted FASTA record.
 
         Write order is the crash-consistency contract: shard bytes
-        reach disk before the manifest record that references them.
+        reach disk before the manifest record that references them, and
+        the first commit also fsyncs the directory so the files'
+        entries survive power loss.
         """
         if self._shard is None or self._manifest is None:
             raise CheckpointError(
@@ -251,12 +288,13 @@ class CheckpointStore:
         from racon_tpu.resilience.faults import maybe_fault
         maybe_fault("ckpt/commit")
         blob = b">" + name + b"\n" + data + b"\n"
-        off = append_fsync(self._shard, blob)
+        off = append_fsync(self._shard, blob,
+                           sync_dir=None if self._dir_synced
+                           else self.directory)
         rec = {"ev": "contig", "tid": int(tid),
                "name": name.decode("utf-8", "replace"),
                "offset": off, "length": len(blob)}
-        append_fsync(self._manifest, (json.dumps(
-            rec, sort_keys=True) + "\n").encode())
+        self._append_manifest(rec)
         self.committed[int(tid)] = rec
         record_ckpt("commit", tid, len(blob))
 
@@ -270,8 +308,7 @@ class CheckpointStore:
         from racon_tpu.resilience.faults import maybe_fault
         maybe_fault("ckpt/commit")
         rec = {"ev": "contig", "tid": int(tid), "emitted": False}
-        append_fsync(self._manifest, (json.dumps(
-            rec, sort_keys=True) + "\n").encode())
+        self._append_manifest(rec)
         self.committed[int(tid)] = rec
         record_ckpt("commit", tid, 0)
 

@@ -12,24 +12,46 @@ Spec grammar (clauses joined by ``;``)::
     spec    := clause (';' clause)*
     clause  := site ':' selector ['!' action]
              | 'seed=' int
+             | 'skew=' float               # lease-clock skew, seconds
     selector:= index (',' index)*          # explicit call indices
              | 'p=' float                  # per-call probability
     action  := 'raise'                     # default: InjectedFault
-             | 'kill'                      # os._exit(137), no cleanup
+             | 'kill'                      # hard exit 137, no cleanup
              | 'term' | 'int'             # signal self (SIGTERM/SIGINT)
+             | 'torn'                      # tear the write in progress
 
 Examples::
 
     RACON_TPU_FAULTS='h2d/chunk:0,1,2'        # first 3 chunk uploads fail
     RACON_TPU_FAULTS='d2h/chunk:p=0.05;seed=7'  # 5% of pulls, seeded
     RACON_TPU_FAULTS='ckpt/commit:1!kill'     # die during 2nd commit
+    RACON_TPU_FAULTS='dist/contig:1!kill'     # evict worker mid-shard
+    RACON_TPU_FAULTS='ckpt/manifest:0!torn'   # half-written manifest line
+    RACON_TPU_FAULTS='skew=9999'              # every lease looks expired
 
 Site names match the transfer labels in obs (``h2d/chunk``,
 ``d2h/chunk``, ``h2d/align``, ``d2h/align``, ``d2h/sp``,
-``h2d/repack``, ``sched/flags``) plus ``dispatch/chunk`` and
-``ckpt/commit``. Call indices are 0-based and advance once per
-*attempt* at that site (each retry re-consults the injector), so
-``site:0,1`` verifies genuine two-failure recovery.
+``h2d/repack``, ``sched/flags``) plus ``dispatch/chunk``,
+``ckpt/commit``, ``ckpt/manifest`` (between the checkpoint's shard and
+manifest appends — the mid-commit eviction window), and the distributed
+worker's eviction points ``dist/claim`` / ``dist/shard`` /
+``dist/contig`` / ``dist/merge`` (racon_tpu/distributed/). Call indices
+are 0-based and advance once per *attempt* at that site (each retry
+re-consults the injector), so ``site:0,1`` verifies genuine two-failure
+recovery.
+
+Eviction-class extensions (preemption drills, docs/DISTRIBUTED.md):
+
+- ``kill`` routes through :func:`hard_exit` (still ``os._exit``, no
+  cleanup) so in-process tests can intercept the death;
+- ``torn`` is consumed by write sites that support tearing
+  (:func:`maybe_torn`): the site writes a *partial* record, makes it
+  durable, and hard-exits — the canonical torn-manifest crash. At a
+  site that only calls :func:`maybe_fault` a ``torn`` rule degrades to
+  ``raise``;
+- ``skew=S`` shifts the distributed ledger's lease clock by S seconds
+  (:func:`clock_skew`), so lease expiry — normally a wall-clock wait —
+  is provable instantly in tier-1.
 
 Determinism: explicit-index decisions are pure functions of the per-site
 call counter; probability decisions hash ``(seed, site, index)`` — the
@@ -51,7 +73,15 @@ from typing import Dict, List, Optional, Tuple
 
 ENV_FAULTS = "RACON_TPU_FAULTS"
 
-_ACTIONS = ("raise", "kill", "term", "int")
+_ACTIONS = ("raise", "kill", "term", "int", "torn")
+
+
+def hard_exit(code: int) -> None:
+    """Simulated hard crash: no atexit, no flushes — exactly the
+    scenario the checkpoint store's fsync ordering protects. A seam so
+    in-process tests can intercept the death; production faults really
+    do ``os._exit``."""
+    os._exit(code)
 
 
 class InjectedFault(RuntimeError):
@@ -84,9 +114,10 @@ class _SiteRule:
         self.action = action
 
 
-def _parse(spec: str) -> Tuple[Dict[str, _SiteRule], int]:
+def _parse(spec: str) -> Tuple[Dict[str, _SiteRule], int, float]:
     rules: Dict[str, _SiteRule] = {}
     seed = 0
+    skew = 0.0
     for clause in filter(None, (c.strip() for c in spec.split(";"))):
         if clause.startswith("seed="):
             try:
@@ -94,6 +125,13 @@ def _parse(spec: str) -> Tuple[Dict[str, _SiteRule], int]:
             except ValueError:
                 raise FaultSpecError(
                     f"[racon_tpu::faults] bad seed clause {clause!r}")
+            continue
+        if clause.startswith("skew="):
+            try:
+                skew = float(clause[5:])
+            except ValueError:
+                raise FaultSpecError(
+                    f"[racon_tpu::faults] bad skew clause {clause!r}")
             continue
         if ":" not in clause:
             raise FaultSpecError(
@@ -126,14 +164,14 @@ def _parse(spec: str) -> Tuple[Dict[str, _SiteRule], int]:
             raise FaultSpecError(
                 f"[racon_tpu::faults] bad selector {sel!r} in clause "
                 f"{clause!r}")
-    return rules, seed
+    return rules, seed, skew
 
 
 class FaultInjector:
     """Parsed fault plan + per-site call counters."""
 
     def __init__(self, spec: str, seed: Optional[int] = None):
-        self._rules, parsed_seed = _parse(spec)
+        self._rules, parsed_seed, self.skew = _parse(spec)
         self.seed = parsed_seed if seed is None else int(seed)
         self.spec = spec
         self._lock = threading.Lock()
@@ -154,8 +192,14 @@ class FaultInjector:
         u = int.from_bytes(h[:8], "big") / 2 ** 64
         return rule.action if u < rule.prob else None
 
-    def check(self, site: str) -> None:
-        """Advance ``site``'s call counter; fire if the plan says so."""
+    def check(self, site: str, torn_ok: bool = False) -> bool:
+        """Advance ``site``'s call counter; fire if the plan says so.
+
+        ``torn_ok``: the caller is a write site that supports torn
+        writes — a ``torn`` action returns True (the caller tears its
+        write and hard-exits) instead of raising. Returns False when
+        nothing fired.
+        """
         with self._lock:
             index = self._counts.get(site, 0)
             self._counts[site] = index + 1
@@ -163,17 +207,20 @@ class FaultInjector:
             if action is not None:
                 self.fired.append((site, index, action))
         if action is None:
-            return
+            return False
         from racon_tpu.obs.metrics import record_fault
         record_fault(site, index, action)
-        if action == "raise":
+        if action == "torn" and torn_ok:
+            return True
+        if action in ("raise", "torn"):
+            # A torn rule at a site with no write to tear degrades to a
+            # plain synthetic failure.
             raise InjectedFault(site, index)
         if action == "kill":
-            # Simulated hard crash: no atexit, no flushes — exactly the
-            # scenario the checkpoint store's fsync ordering protects.
-            os._exit(137)
+            hard_exit(137)
         os.kill(os.getpid(), signal.SIGTERM if action == "term"
                 else signal.SIGINT)
+        return False
 
     def counts(self) -> Dict[str, int]:
         with self._lock:
@@ -210,3 +257,24 @@ def maybe_fault(site: str) -> None:
     inj = get_injector()
     if inj is not None:
         inj.check(site)
+
+
+def maybe_torn(site: str) -> bool:
+    """The hook a tear-capable write site runs before its append.
+
+    Returns True when a ``torn`` rule fires there — the caller must
+    then write a *partial* record, fsync it, and :func:`hard_exit`
+    (a torn write only matters if the process dies before finishing
+    it). Other actions at the site behave exactly as in
+    :func:`maybe_fault`.
+    """
+    inj = get_injector()
+    return inj.check(site, torn_ok=True) if inj is not None else False
+
+
+def clock_skew() -> float:
+    """Seconds the distributed ledger shifts its lease clock by
+    (``skew=S`` spec clause) — makes live leases look expired so steal
+    paths are provable without wall-clock waits. 0.0 when unarmed."""
+    inj = get_injector()
+    return inj.skew if inj is not None else 0.0

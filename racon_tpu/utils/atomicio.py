@@ -22,7 +22,7 @@ atomicity — the caller documents which write commits.
 from __future__ import annotations
 
 import os
-from typing import Union
+from typing import Optional, Union
 
 
 def fsync_dir(path: str) -> None:
@@ -66,11 +66,85 @@ def atomic_finalize(tmp_path: str, final_path: str) -> None:
     fsync_dir(os.path.dirname(os.path.abspath(final_path)))
 
 
-def append_fsync(fh, data: Union[bytes, str]) -> int:
+def append_fsync(fh, data: Union[bytes, str],
+                 sync_dir: Optional[str] = None) -> int:
     """Append one record to an open file and make it durable; returns
-    the record's start offset (the caller's manifest pointer)."""
-    off = fh.tell()
+    the record's start offset (the caller's manifest pointer).
+
+    The offset is taken by seeking to the end first, so a handle that
+    raced another appender (the distributed steal window) still records
+    where *its* bytes landed, not a stale position.
+
+    ``sync_dir``: also fsync the containing directory. File fsync alone
+    does not make the file's *directory entry* durable — a freshly
+    created store could lose whole files (committed contigs included)
+    on power loss. Callers pass the directory on the first append after
+    creating a file; later appends don't need it.
+    """
+    off = fh.seek(0, os.SEEK_END)
     fh.write(data)
     fh.flush()
     os.fsync(fh.fileno())
+    if sync_dir is not None:
+        fsync_dir(sync_dir)
     return off
+
+
+def publish_exclusive(path: str, data: bytes) -> bool:
+    """Atomically publish ``data`` at ``path`` iff nothing is there yet.
+
+    The first-claim primitive of the distributed work ledger: the bytes
+    are fully written and fsync'd in a tmp file, then ``os.link``ed to
+    the final name — link fails with EEXIST if any other process
+    published first, so readers only ever see complete files and
+    exactly one publisher wins. Returns True for the winner.
+    """
+    d = os.path.dirname(os.path.abspath(path))
+    tmp = f"{path}.pub.{os.getpid()}"
+    with open(tmp, "wb") as fh:
+        fh.write(data)
+        fh.flush()
+        os.fsync(fh.fileno())
+    try:
+        os.link(tmp, path)
+        won = True
+    except FileExistsError:
+        won = False
+    finally:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+    if won:
+        fsync_dir(d)
+    return won
+
+
+def load_jsonl_prefix(path: str, validate=None):
+    """Read a JSONL file's longest valid record prefix.
+
+    Crash-tolerant by construction: a final partially-written line (no
+    trailing newline — a torn append), a JSON-invalid line, a non-object
+    record, or a record ``validate(rec)`` rejects all end the prefix
+    there instead of raising — everything before it is still trusted.
+    Returns ``(records, clean)``; ``clean`` is False when anything was
+    dropped, so callers know to rewrite the file.
+    """
+    import json
+    with open(path, "rb") as fh:
+        raw = fh.read()
+    records = []
+    lines = raw.split(b"\n")
+    clean = not lines or lines[-1] == b""
+    for line in lines[:-1] if lines else []:
+        try:
+            rec = json.loads(line)
+            if not isinstance(rec, dict):
+                raise ValueError("non-object JSONL record")
+            if validate is not None:
+                validate(rec)
+        except (ValueError, KeyError, TypeError, AttributeError):
+            clean = False
+            break
+        records.append(rec)
+    return records, clean

@@ -36,6 +36,9 @@ KIND_REQUIRED_ATTRS = {
     # One query-axis tile of the tiled ultralong overlap forward,
     # emitted under the ovl_tiled_chunk dispatch span (ops/ovl_align.py).
     "tile": ("index", "rows", "W"),
+    # One distributed-ledger event (claim/steal/renew/commit/merge,
+    # racon_tpu/distributed/): which shard, and which worker did it.
+    "dist": ("shard", "worker"),
 }
 
 # Span intervals are rounded to 1e-6 on write and a parent's clock stops
@@ -187,6 +190,7 @@ def render(tr: Dict[str, object], out=sys.stdout) -> None:
     m = tr["metrics"]
     _render_pipeline(m, out)
     _render_resilience(m, by_kind, out)
+    _render_dist(m, by_kind, out)
     if m:
         keys = [k for k in sorted(m) if k != "ev"]
         print("\nmetrics:", file=out)
@@ -279,6 +283,42 @@ def _render_resilience(m, by_kind, out) -> None:
         print(f"checkpoint: commits={commits}  resumed_skips={skips}  "
               f"bytes={_fmt_bytes(float(m.get('res_ckpt_bytes', 0)))}",
               file=out)
+
+
+def _render_dist(m, by_kind, out) -> None:
+    """The "Distributed" section: fleet shape, claim/steal/lease
+    counters, and per-worker event counts, from the ``dist_*`` metrics
+    and ``dist`` spans the work ledger records (docs/DISTRIBUTED.md).
+    Single-process runs (no dist_* activity) print nothing."""
+    m = m or {}
+    dist = {k: v for k, v in m.items() if k.startswith("dist_")}
+    spans = by_kind.get("dist", [])
+    if not dist and not spans:
+        return
+    print(f"\ndistributed: workers={int(m.get('dist_workers', 0))}  "
+          f"shards={int(m.get('dist_shards', 0))}  "
+          f"targets={int(m.get('dist_n_targets', 0))}", file=out)
+    print(f"  claims={int(m.get('dist_claims', 0))}  "
+          f"stolen={int(m.get('dist_shards_stolen', 0))}  "
+          f"lease_renewals={int(m.get('dist_lease_renewals', 0))}  "
+          f"leases_lost={int(m.get('dist_leases_lost', 0))}", file=out)
+    print(f"  contigs: polished={int(m.get('dist_contigs_polished', 0))}"
+          f"  resumed={int(m.get('dist_contigs_resumed', 0))}  "
+          f"repolished={int(m.get('dist_contigs_repolished', 0))}",
+          file=out)
+    lat = float(m.get("dist_steal_latency_s", 0.0))
+    rec = float(m.get("dist_recovery_wall_s", 0.0))
+    if lat or rec:
+        print(f"  steal latency {lat:.3f}s  recovery wall {rec:.3f}s",
+              file=out)
+    if spans:
+        by_worker: Dict[str, int] = {}
+        for s in spans:
+            by_worker[str(s.get("worker"))] = \
+                by_worker.get(str(s.get("worker")), 0) + 1
+        workers = ", ".join(f"{w}: {n}" for w, n in
+                            sorted(by_worker.items()))
+        print(f"  events by worker: {workers}", file=out)
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -1,0 +1,317 @@
+"""Distributed work-ledger tests: shard partition, lease claim/steal
+fencing, per-shard checkpoint resume, ordered merge, and the CLI worker
+surface (racon_tpu/distributed/, docs/DISTRIBUTED.md).
+
+Eviction drills run in-process by monkeypatching the injector's
+hard-exit seam; the real multi-process drill (kills, SIGTERM
+mid-commit, byte-diff vs serial) is scripts/preemption_smoke.py.
+"""
+
+import contextlib
+import io
+import json
+import os
+
+import numpy as np
+import pytest
+
+from racon_tpu.distributed import (LeaseLost, LedgerError, WorkLedger)
+from racon_tpu.distributed import ledger as dledger
+from racon_tpu.obs import metrics as obs_metrics
+from racon_tpu.resilience import checkpoint as ckpt
+from racon_tpu.resilience import faults, retry
+
+BASES = np.frombuffer(b"ACGT", np.uint8)
+
+
+@pytest.fixture(autouse=True)
+def dist_sandbox(monkeypatch):
+    monkeypatch.delenv(retry.ENV_RETRY, raising=False)
+    monkeypatch.delenv(faults.ENV_FAULTS, raising=False)
+    monkeypatch.delenv(dledger.ENV_SHARDS, raising=False)
+    retry.configure(None)
+    faults.configure(None)
+    obs_metrics.reset()
+    yield
+    retry.configure(None)
+    faults.configure(None)
+    obs_metrics.reset()
+
+
+# ------------------------------------------------------------ partition
+
+
+def test_partition_bounds_balanced():
+    assert dledger._partition(6, 3) == [0, 2, 4, 6]
+    assert dledger._partition(7, 3) == [0, 3, 5, 7]
+    assert dledger._partition(2, 2) == [0, 1, 2]
+    # Shards never outnumber targets (clamped at open()).
+    b = dledger._partition(3, 3)
+    assert b == [0, 1, 2, 3]
+
+
+def test_open_publishes_once_and_joins(tmp_path, monkeypatch):
+    d = str(tmp_path / "ledger")
+    a = WorkLedger.open(d, "fp1", n_targets=6, workers=2)
+    assert a.n_shards == 4 and a.bounds[-1] == 6
+    # A second worker with *different* flags adopts the published
+    # partition — meta.json is the contract, not the CLI.
+    b = WorkLedger.open(d, "fp1", n_targets=6, workers=7, lease_s=1.0)
+    assert b.bounds == a.bounds and b.lease_s == a.lease_s
+
+    with pytest.raises(LedgerError, match="fingerprint"):
+        WorkLedger.open(d, "fp2", n_targets=6)
+    with pytest.raises(LedgerError, match="target count"):
+        WorkLedger.open(d, "fp1", n_targets=5)
+    with pytest.raises(LedgerError, match="empty target set"):
+        WorkLedger.open(str(tmp_path / "x"), "fp1", n_targets=0)
+
+    monkeypatch.setenv(dledger.ENV_SHARDS, "3")
+    c = WorkLedger.open(str(tmp_path / "env"), "fp1", n_targets=6)
+    assert c.n_shards == 3
+
+
+def test_claim_lifecycle_and_done(tmp_path):
+    led = WorkLedger.open(str(tmp_path / "l"), "fp", n_targets=4,
+                          workers=1)  # 2 shards
+    a = led.claim_shard("A")
+    b = led.claim_shard("B")
+    assert (a.shard, b.shard) == (0, 1) and not a.stolen
+    # Everything live-leased: nothing left to claim.
+    assert led.claim_shard("C") is None
+
+    led.verify(a)
+    old = a.deadline
+    led.renew(a)
+    assert a.deadline >= old
+
+    led.complete(a, n_committed=2)
+    assert led.is_done("shard_0") and not led.shards_done()
+    assert led.claim_shard("C") is None      # done + leased
+    led.complete(b)
+    assert led.shards_done()
+    ev = [e["ev"] for e in led.events()]
+    assert ev.count("claim") == 2 and ev.count("complete") == 2
+
+
+def test_steal_after_expiry_fences_victim(tmp_path):
+    led = WorkLedger.open(str(tmp_path / "l"), "fp", n_targets=2,
+                          workers=1, n_shards=1)
+    a = led.claim_shard("A")
+    # Fresh lease: a second worker cannot touch it.
+    assert led.claim_shard("B") is None
+    # Shift only the thief's clock (the skew= fault clause): the lease
+    # now looks expired and B steals it.
+    faults.configure("skew=9999")
+    b = led.claim_shard("B")
+    assert b is not None and b.stolen and b.epoch == a.epoch + 1
+    # The victim's nonce is gone: every fenced operation refuses.
+    faults.configure(None)
+    with pytest.raises(LeaseLost):
+        led.renew(a)
+    with pytest.raises(LeaseLost):
+        led.complete(a)
+    # The thief still owns it.
+    led.renew(b)
+    led.complete(b)
+    snap = obs_metrics.registry().snapshot()
+    assert snap["dist_shards_stolen"] == 1
+    assert snap["dist_leases_expired"] == 1
+    assert snap["dist_leases_lost"] == 2
+    assert "dist_steal_latency_s" in snap
+
+
+def test_torn_lease_is_stealable(tmp_path):
+    """A worker that died mid-lease-publish leaves an unreadable lease;
+    it must count as expired, not wedge the shard forever."""
+    led = WorkLedger.open(str(tmp_path / "l"), "fp", n_targets=2,
+                          workers=1, n_shards=1)
+    with open(led._lease_path("shard_0"), "wb") as fh:
+        fh.write(b'{"worker": "A", "dead')
+    c = led.claim_shard("B")
+    assert c is not None and c.stolen
+
+
+def test_merge_guards(tmp_path):
+    led = WorkLedger.open(str(tmp_path / "l"), "fp", n_targets=2,
+                          workers=1, n_shards=1)
+    with pytest.raises(LedgerError, match="still pending"):
+        led.merge()
+    # A done marker whose store doesn't cover the shard's range is
+    # corruption, not something to paper over.
+    claim = led.claim_shard("A")
+    store = ckpt.CheckpointStore.create(led.shard_ckpt_dir(0),
+                                        led.shard_fp(0))
+    store.commit(0, b"c0", b"AAAA")
+    store.close()
+    led.complete(claim)
+    with pytest.raises(LedgerError, match="no committed record"):
+        led.merge()
+
+
+def test_merge_orders_and_concatenates(tmp_path):
+    led = WorkLedger.open(str(tmp_path / "l"), "fp", n_targets=4,
+                          workers=1)  # bounds [0,2,4]
+    for k in range(2):
+        claim = led.claim_shard(f"W{k}")
+        store = ckpt.CheckpointStore.create(led.shard_ckpt_dir(k),
+                                            led.shard_fp(k))
+        lo, hi = led.shard_range(k)
+        for tid in range(lo, hi):
+            if tid == 1:
+                store.commit_dropped(tid)   # dropped target: no bytes
+            else:
+                store.commit(tid, b"c%d" % tid, b"A" * (tid + 1))
+        store.close()
+        led.complete(claim)
+    nbytes, emitted = led.merge()
+    assert emitted == 3
+    data = open(led.out_path, "rb").read()
+    assert len(data) == nbytes
+    assert data == b">c0\nA\n>c2\nAAA\n>c3\nAAAA\n"
+
+
+# -------------------------------------------------- CLI worker surface
+
+
+def _mutate(rng, truth):
+    out = []
+    for b in truth:
+        r = rng.random()
+        if r < 0.04:
+            continue
+        out.append(int(BASES[rng.integers(0, 4)]) if r < 0.08 else int(b))
+    return bytes(out)
+
+
+def _write_inputs(d, n_contigs=4, n_reads=6, clen=300):
+    rng = np.random.default_rng(11)
+    drafts, reads, paf = [], [], []
+    for ci in range(n_contigs):
+        truth = BASES[rng.integers(0, 4, clen)]
+        draft = _mutate(rng, truth)
+        drafts.append(b">c%d\n%s\n" % (ci, draft))
+        for i in range(n_reads):
+            r = _mutate(rng, truth)
+            name = f"c{ci}r{i}"
+            reads.append(b">" + name.encode() + b"\n" + r + b"\n")
+            paf.append(f"{name}\t{len(r)}\t0\t{len(r)}\t+\tc{ci}"
+                       f"\t{len(draft)}\t0\t{len(draft)}"
+                       f"\t{min(len(r), len(draft))}"
+                       f"\t{max(len(r), len(draft))}\t60")
+    (d / "draft.fasta").write_bytes(b"".join(drafts))
+    (d / "reads.fasta").write_bytes(b"".join(reads))
+    (d / "ovl.paf").write_text("\n".join(paf) + "\n")
+
+
+def _run_cli(d, *extra):
+    from racon_tpu import cli
+
+    stdout = io.StringIO()
+    stdout.buffer = io.BytesIO()
+    err = io.StringIO()
+    with contextlib.redirect_stdout(stdout), \
+            contextlib.redirect_stderr(err):
+        rc = cli.main(["--backend", "jax", *extra,
+                       str(d / "reads.fasta"), str(d / "ovl.paf"),
+                       str(d / "draft.fasta")])
+    return rc, stdout.buffer.getvalue(), err.getvalue()
+
+
+def test_cli_flag_conflicts(tmp_path):
+    _write_inputs(tmp_path, n_contigs=1)
+    rc, _, err = _run_cli(tmp_path, "--ledger-dir",
+                          str(tmp_path / "l"), "--checkpoint-dir",
+                          str(tmp_path / "ck"))
+    assert rc == 1 and "manages per-shard checkpoints" in err
+    rc, _, err = _run_cli(tmp_path, "--ledger-dir",
+                          str(tmp_path / "l"), "--workers", "0")
+    assert rc == 1 and "invalid --workers" in err
+    rc, _, err = _run_cli(tmp_path, "--ledger-dir",
+                          str(tmp_path / "l"), "--lease-s", "0")
+    assert rc == 1 and "invalid --lease-s" in err
+
+
+def test_ledger_cli_byte_identity(tmp_path):
+    """One worker, whole fleet: the sharded run's merged stdout must be
+    byte-identical to the serial path, with dist_* accounting."""
+    _write_inputs(tmp_path)
+    rc, base, _ = _run_cli(tmp_path)
+    assert rc == 0 and base.count(b">") == 4
+
+    ld = str(tmp_path / "ledger")
+    obs_metrics.reset()
+    rc, out, err = _run_cli(tmp_path, "--ledger-dir", ld,
+                            "--worker-id", "solo")
+    assert rc == 0, err
+    assert out == base
+    snap = obs_metrics.registry().snapshot()
+    assert snap["dist_shards"] == 2 and snap["dist_n_targets"] == 4
+    assert snap["dist_claims"] == 2
+    assert snap["dist_shards_completed"] == 2
+    assert snap["dist_contigs_polished"] == 4
+    assert snap["dist_merges"] == 1
+    assert "dist_shards_stolen" not in snap
+    assert open(os.path.join(ld, dledger.OUT_NAME),
+                "rb").read() == base
+    # A late worker joining a finished ledger recomputes nothing and
+    # emits nothing — only the merge winner owns stdout; it points at
+    # the published out.fasta instead.
+    obs_metrics.reset()
+    rc, again, err = _run_cli(tmp_path, "--ledger-dir", ld,
+                              "--worker-id", "late")
+    assert rc == 0 and again == b""
+    assert "already published" in err
+    assert "dist_contigs_polished" not in \
+        obs_metrics.registry().snapshot()
+
+
+def test_eviction_steal_resume_byte_identity(tmp_path):
+    """The tier-1 eviction drill: a worker crashes mid-shard (injected
+    fault between contigs); a second worker with a skewed lease clock
+    steals the shard, resumes the committed prefix, recomputes only the
+    in-flight contig, and the merged output is byte-identical."""
+    _write_inputs(tmp_path)
+    rc, base, _ = _run_cli(tmp_path)
+    assert rc == 0
+
+    ld = str(tmp_path / "ledger")
+    # 4 contigs, 2 shards ([0,2) and [2,4)). The fault fires at the 4th
+    # dist/contig event: shard_0 completes (c0, c1), then c2 commits on
+    # shard_1 and the worker dies before c3.
+    faults.configure("dist/contig:3")
+    with pytest.raises(faults.InjectedFault):
+        _run_cli(tmp_path, "--ledger-dir", ld, "--worker-id", "victim")
+    led = WorkLedger.open(ld, fingerprint=_ledger_fp(ld),
+                          n_targets=4)
+    assert led.is_done("shard_0") and not led.is_done("shard_1")
+
+    # Survivor: skewed clock makes the victim's lease expired NOW.
+    obs_metrics.reset()
+    faults.configure("skew=1e9")
+    rc, out, err = _run_cli(tmp_path, "--ledger-dir", ld,
+                            "--worker-id", "thief")
+    assert rc == 0, err
+    assert out == base, "post-eviction merged FASTA differs from serial"
+    snap = obs_metrics.registry().snapshot()
+    assert snap["dist_shards_stolen"] == 1
+    assert snap["dist_contigs_resumed"] == 1       # c2 from the victim
+    assert snap["dist_contigs_polished"] == 1      # only c3 recomputed
+    assert snap["dist_contigs_repolished"] == 1
+    assert "recovery_wall_s" not in snap or \
+        snap["dist_recovery_wall_s"] >= 0
+    # Zero committed contigs re-polished: each tid appears exactly once
+    # across the shard manifests.
+    tids = []
+    for k in range(led.n_shards):
+        man = os.path.join(led.shard_ckpt_dir(k), ckpt.MANIFEST_NAME)
+        for line in open(man, "rb").read().splitlines():
+            rec = json.loads(line)
+            if rec.get("ev") == "contig":
+                tids.append(rec["tid"])
+    assert sorted(tids) == [0, 1, 2, 3]
+
+
+def _ledger_fp(ld):
+    with open(os.path.join(ld, dledger.META_NAME)) as fh:
+        return json.load(fh)["fingerprint"]

@@ -222,6 +222,84 @@ def test_checkpoint_torn_tail_and_orphan_shard_recovery(tmp_path):
     res.close()
 
 
+class _Died(BaseException):
+    """Stand-in for os._exit in in-process crash drills — BaseException
+    so no library except-clause can swallow the 'death'."""
+
+
+@pytest.fixture
+def soft_crash(monkeypatch):
+    """Intercept the injector's hard-exit seam so kill/torn faults are
+    testable in-process; yields the exception type the 'death' raises."""
+    monkeypatch.setattr(faults, "hard_exit",
+                        lambda code: (_ for _ in ()).throw(_Died(code)))
+    return _Died
+
+
+def test_first_commit_fsyncs_directory(tmp_path, monkeypatch):
+    """The crash-consistency fix: file fsync alone doesn't make a fresh
+    file's directory entry durable, so the first append after creating
+    the store must also fsync the directory — and later commits must
+    not keep paying for it."""
+    from racon_tpu.utils import atomicio
+    synced = []
+    monkeypatch.setattr(atomicio, "fsync_dir",
+                        lambda p: synced.append(os.path.abspath(p)))
+    d = str(tmp_path / "ck")
+    store = ckpt.CheckpointStore.create(d, "fp1")    # begin header
+    store.commit(0, b"c0", b"AAAA")                  # first commit
+    assert synced.count(os.path.abspath(d)) >= 2     # meta + appends
+    synced.clear()
+    store.commit(1, b"c1", b"CCCC")
+    store.commit_dropped(2)
+    assert synced == []          # directory entry already durable
+    store.close()
+
+
+def test_kill_between_appends_leaves_resumable_store(tmp_path,
+                                                     soft_crash):
+    """Eviction in the mid-commit window (after the shard append,
+    before the manifest record): the orphaned shard bytes are discarded
+    on resume and only that contig recomputes."""
+    faults.configure("ckpt/manifest:1!kill")
+    d = str(tmp_path / "ck")
+    store = ckpt.CheckpointStore.create(d, "fp1")
+    store.commit(0, b"c0", b"AAAA")
+    with pytest.raises(soft_crash):
+        store.commit(1, b"c1", b"CCCC")
+    store.close()
+    # c1's shard bytes landed, its manifest record didn't.
+    assert b">c1\n" in open(store.shard_path, "rb").read()
+    faults.configure(None)
+    res = ckpt.CheckpointStore.resume(d, "fp1")
+    assert sorted(res.committed) == [0]
+    assert os.path.getsize(res.shard_path) == len(b">c0\nAAAA\n")
+    res.commit(1, b"c1", b"CCCC")                    # recompute works
+    assert res.read_emitted(1) == b">c1\nCCCC\n"
+    res.close()
+
+
+def test_torn_manifest_fault_roundtrip(tmp_path, soft_crash):
+    """The torn action at ckpt/manifest writes *half* the record
+    durably then dies — resume must truncate to the last valid record
+    and rewrite the manifest clean."""
+    faults.configure("ckpt/manifest:1!torn")
+    d = str(tmp_path / "ck")
+    store = ckpt.CheckpointStore.create(d, "fp1")
+    store.commit(0, b"c0", b"AAAA")
+    with pytest.raises(soft_crash):
+        store.commit(1, b"c1", b"CCCC")
+    store.close()
+    raw = open(store.manifest_path, "rb").read()
+    assert not raw.endswith(b"\n")       # genuinely torn tail
+    faults.configure(None)
+    res = ckpt.CheckpointStore.resume(d, "fp1")
+    assert sorted(res.committed) == [0]
+    lines = open(res.manifest_path, "rb").read()
+    assert lines.endswith(b"\n") and lines.count(b"\n") == 2
+    res.close()
+
+
 def test_checkpoint_fingerprint_mismatch_refuses(tmp_path):
     d = str(tmp_path / "ck")
     ckpt.CheckpointStore.create(d, "fp1").close()
@@ -362,6 +440,34 @@ def test_cli_resume_byte_identity(tmp_path):
     rc, _, err = _run_cli(tmp_path, "--checkpoint-dir", ck, "--resume",
                           "--match", "6")
     assert rc == 1 and "refusing to resume" in err
+
+
+def test_cli_sigterm_mid_commit_resumes_byte_identical(tmp_path):
+    """SIGTERM delivered in the mid-commit window (between the shard
+    append and the manifest append, via the ckpt/manifest term action):
+    the run exits 143, the half-committed contig's shard bytes are
+    orphaned, and --resume still reproduces the serial bytes exactly."""
+    _write_inputs(tmp_path)
+    ck = str(tmp_path / "ck")
+    rc, base, _ = _run_cli(tmp_path)
+    assert rc == 0
+
+    faults.configure("ckpt/manifest:1!term")
+    rc, _, err = _run_cli(tmp_path, "--checkpoint-dir", ck)
+    assert rc == 143, err
+    assert "interrupted (signal 15); 1 contig(s) committed" in err
+    # The second contig's shard bytes landed without a manifest record.
+    shard_size = os.path.getsize(os.path.join(ck, ckpt.SHARD_NAME))
+    man = open(os.path.join(ck, ckpt.MANIFEST_NAME), "rb").read()
+    recs = [json.loads(x) for x in man.splitlines()]
+    committed = [r for r in recs if r.get("ev") == "contig"]
+    assert len(committed) == 1
+    end = committed[0]["offset"] + committed[0]["length"]
+    assert shard_size > end, "expected orphaned mid-commit shard bytes"
+
+    faults.configure(None)
+    rc, out, _ = _run_cli(tmp_path, "--checkpoint-dir", ck, "--resume")
+    assert rc == 0 and out == base
 
 
 def test_cli_resume_requires_checkpoint_dir(tmp_path):
