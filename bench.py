@@ -258,8 +258,20 @@ def main():
     extras = {**sched_extras, **e2e_transfers, **pipe_extras,
               **probe_extras, **adaptive_extras,
               **cache_extras(), **obs_metrics.resilience_extras(),
-              **obs_metrics.ovl_extras(), **obs_metrics.dist_extras()}
+              **obs_metrics.ovl_extras(), **obs_metrics.dist_extras(),
+              **obs_metrics.redo_extras()}
     out = {
+        # metric_version 9: same primary value as versions 2-8 (the
+        # chunk program changed again this round — quad-column packed
+        # walk over the new u16 nxt2 plane, bit-identity-gated — so
+        # compute-rate deltas vs version 8 are real perf). New in 9:
+        # walk_chain_len (the serialized dependent-gather count of the
+        # timed chunk's column walk, 161 at bench geometry under the
+        # default RACON_TPU_WALK_K=4, 321 at k=2) and the
+        # redo_device_windows / redo_host_windows counters from the
+        # wide-band on-device redo (ops/redo.py) — host_windows stays 0
+        # at bench geometry, so a perf number produced while windows
+        # escaped to the host mid-polish is visibly flagged.
         # metric_version 8: same primary value as versions 2-7 (the
         # bench itself is single-process). New in 8: the dist_*
         # distributed-ledger extras (claims / shards_stolen /
@@ -309,7 +321,7 @@ def main():
         # fixed_engine_windows_per_sec. Bump this whenever the primary
         # value's definition changes, so round-over-round comparisons
         # can't silently mix metrics.
-        "metric_version": 8,
+        "metric_version": 9,
         "metric": f"POA windows/sec/chip, compute-only (direct-timed warm "
                   f"production chunk, convergence-scheduled refinement "
                   f"rounds — racon_tpu/sched/, telemetry in sched_* "

@@ -59,4 +59,7 @@ python scripts/resilience_smoke.py
 echo "[ci] preemption smoke (2-worker fleet, 3 evictions, steal + merge byte-diff)"
 python scripts/preemption_smoke.py
 
+echo "[ci] redo smoke (flagged windows resolve on device, zero host redos, byte-diff)"
+python scripts/redo_smoke.py
+
 echo "[ci] OK"

@@ -277,15 +277,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         with tracer.span("run", "racon_tpu"):
             if args.ledger_dir:
                 from racon_tpu.distributed.worker import run_worker
-                from racon_tpu.io.parsers import create_sequence_parser
+                from racon_tpu.io.parsers import scan_sequence_index
                 from racon_tpu.resilience.checkpoint import \
                     run_fingerprint
                 fp = run_fingerprint(ckpt_config, args.paths[:3])
-                n_targets = len(
-                    create_sequence_parser(args.paths[2]).parse_all())
+                # Deferred target count: only the worker that publishes
+                # the ledger meta scans the target file; later joiners
+                # adopt the published count + offsets (satellite of
+                # ROADMAP item 2 — per-worker full parses were pure
+                # duplicated I/O).
                 rc = run_worker(
                     ledger_dir=args.ledger_dir, fingerprint=fp,
-                    n_targets=n_targets, worker_id=args.worker_id,
+                    scan_targets=lambda: scan_sequence_index(
+                        args.paths[2]),
+                    worker_id=args.worker_id,
                     workers=args.workers, lease_s=args.lease_s,
                     make_polisher=make_polisher,
                     drop_unpolished=not args.include_unpolished,

@@ -118,12 +118,19 @@ class WorkLedger:
         self.n_shards: int = len(self.bounds) - 1
         self.n_targets: int = int(meta["n_targets"])
         self.lease_s: float = float(meta["lease_s"])
+        # Optional per-target byte offsets into the target file (from
+        # io.parsers.scan_sequence_index, published by the winner) —
+        # observability plus a future seek-to-shard ingest hook.
+        off = meta.get("target_offsets")
+        self.target_offsets: Optional[List[int]] = \
+            None if off is None else [int(o) for o in off]
 
     # ------------------------------------------------------- open
     @classmethod
     def open(cls, directory: str, fingerprint: str, *,
-             n_targets: int, workers: int = 1, lease_s: float = 30.0,
-             n_shards: Optional[int] = None) -> "WorkLedger":
+             n_targets: Optional[int] = None, workers: int = 1,
+             lease_s: float = 30.0, n_shards: Optional[int] = None,
+             scan_targets=None) -> "WorkLedger":
         """Open (publishing if first) the ledger for this run.
 
         Every worker calls this with its own view of the run identity;
@@ -131,40 +138,58 @@ class WorkLedger:
         everyone else adopts the published partition — so all workers
         agree on shard bounds and lease duration even if their CLI
         flags disagree.
+
+        ``n_targets`` may be None when ``scan_targets`` (a callable
+        returning ``(count, per-target byte offsets)``, typically
+        io.parsers.scan_sequence_index on the target file) is given: a
+        worker joining an ALREADY-PUBLISHED ledger then adopts the
+        published count without touching the target file at all — the
+        fingerprint check still guards against mismatched inputs, so
+        the per-worker recount it replaces was pure duplicated I/O
+        (docs/DISTRIBUTED.md's ingest note). Only the publishing worker
+        pays the scan, and it publishes the offsets alongside the count
+        so nobody ever scans twice.
         """
-        if n_targets < 1:
-            raise LedgerError(
-                "[racon_tpu::dist] refusing to open a ledger for an "
-                "empty target set")
-        if n_shards is None:
-            env = os.environ.get(ENV_SHARDS, "")
-            if env:
-                n_shards = int(env)
-            else:
-                # Over-partition ~2x the fleet so a steal transfers a
-                # shard's worth of work, not half the run.
-                n_shards = max(1, int(workers) * 2)
-        n_shards = max(1, min(int(n_shards), n_targets))
-        os.makedirs(directory, exist_ok=True)
-        meta = {
-            "schema": SCHEMA,
-            "fingerprint": fingerprint,
-            "n_targets": int(n_targets),
-            "bounds": _partition(n_targets, n_shards),
-            "lease_s": float(lease_s),
-            "workers": int(workers),
-        }
         path = os.path.join(directory, META_NAME)
-        blob = (json.dumps(meta, sort_keys=True) + "\n").encode()
-        publish_exclusive(path, blob)
-        # Winner or not, the published file is the contract.
-        try:
-            with open(path, "r", encoding="utf-8") as fh:
-                published = json.load(fh)
-        except (OSError, ValueError) as exc:
-            raise LedgerError(
-                f"[racon_tpu::dist] unreadable ledger {META_NAME} in "
-                f"{directory!r} ({exc})") from exc
+        published: Optional[Dict] = None
+        if os.path.isfile(path):
+            published = cls._read_meta(path, directory)
+        offsets = None
+        if published is None:
+            if n_targets is None:
+                if scan_targets is None:
+                    raise LedgerError(
+                        "[racon_tpu::dist] opening an unpublished "
+                        "ledger needs n_targets or scan_targets")
+                n_targets, offsets = scan_targets()
+            if n_targets < 1:
+                raise LedgerError(
+                    "[racon_tpu::dist] refusing to open a ledger for "
+                    "an empty target set")
+            if n_shards is None:
+                env = os.environ.get(ENV_SHARDS, "")
+                if env:
+                    n_shards = int(env)
+                else:
+                    # Over-partition ~2x the fleet so a steal transfers
+                    # a shard's worth of work, not half the run.
+                    n_shards = max(1, int(workers) * 2)
+            n_shards = max(1, min(int(n_shards), n_targets))
+            os.makedirs(directory, exist_ok=True)
+            meta = {
+                "schema": SCHEMA,
+                "fingerprint": fingerprint,
+                "n_targets": int(n_targets),
+                "bounds": _partition(n_targets, n_shards),
+                "lease_s": float(lease_s),
+                "workers": int(workers),
+            }
+            if offsets is not None:
+                meta["target_offsets"] = [int(o) for o in offsets]
+            blob = (json.dumps(meta, sort_keys=True) + "\n").encode()
+            publish_exclusive(path, blob)
+            # Winner or not, the published file is the contract.
+            published = cls._read_meta(path, directory)
         if published.get("schema") != SCHEMA:
             raise LedgerError(
                 f"[racon_tpu::dist] ledger schema "
@@ -174,12 +199,23 @@ class WorkLedger:
                 "[racon_tpu::dist] refusing to join ledger "
                 f"{directory!r}: its fingerprint does not match this "
                 "run — inputs or output-affecting options changed")
-        if published.get("n_targets") != n_targets:
+        if n_targets is not None and \
+                published.get("n_targets") != n_targets:
             raise LedgerError(
                 f"[racon_tpu::dist] ledger target count "
                 f"{published.get('n_targets')!r} != {n_targets} seen "
                 "by this worker")
         return cls(directory, published)
+
+    @staticmethod
+    def _read_meta(path: str, directory: str) -> Dict:
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                return json.load(fh)
+        except (OSError, ValueError) as exc:
+            raise LedgerError(
+                f"[racon_tpu::dist] unreadable ledger {META_NAME} in "
+                f"{directory!r} ({exc})") from exc
 
     # ------------------------------------------------------ layout
     def shard_range(self, k: int) -> Tuple[int, int]:

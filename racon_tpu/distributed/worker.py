@@ -144,9 +144,10 @@ def _merge_phase(ledger: WorkLedger, worker: str, out, log,
         return 0
 
 
-def run_worker(*, ledger_dir: str, fingerprint: str, n_targets: int,
+def run_worker(*, ledger_dir: str, fingerprint: str,
                worker_id: Optional[str], workers: int, lease_s: float,
                make_polisher: Callable, drop_unpolished: bool,
+               n_targets: Optional[int] = None, scan_targets=None,
                out=None, log=None) -> int:
     """Drive one worker from fleet join to merged output.
 
@@ -154,13 +155,18 @@ def run_worker(*, ledger_dir: str, fingerprint: str, n_targets: int,
     claimed shard, since windows are pruned destructively. Returns a
     process exit code; crashes (injected or real) propagate so the
     process dies exactly as a preempted worker would.
+
+    Pass ``scan_targets`` (io.parsers.scan_sequence_index, deferred)
+    instead of an eager ``n_targets`` so only the meta-publishing
+    worker pays the target-file pass — every later joiner adopts the
+    published count (WorkLedger.open docstring).
     """
     out = out if out is not None else sys.stdout.buffer
     log = log if log is not None else sys.stderr
     worker = worker_id or default_worker_id()
     ledger = WorkLedger.open(ledger_dir, fingerprint,
                              n_targets=n_targets, workers=workers,
-                             lease_s=lease_s)
+                             lease_s=lease_s, scan_targets=scan_targets)
     set_dist("workers", int(workers))
     set_dist("shards", ledger.n_shards)
     set_dist("n_targets", ledger.n_targets)

@@ -166,6 +166,68 @@ def _block_lines(f, block: int = 1 << 22
             pos += nb
 
 
+def scan_sequence_index(path: str) -> Tuple[int, List[int]]:
+    """(record count, per-record byte offsets) of a FASTA/FASTQ file
+    WITHOUT materializing any sequence — one streaming pass that only
+    looks at record structure. Offsets are each record header's byte
+    position in the decompressed stream (``dd``/``tail -c`` friendly,
+    same convention as :class:`ParseError`).
+
+    The distributed ledger publishes this index in ``meta.json`` once:
+    workers that join an already-published ledger used to run a FULL
+    parse of the target file just to count records for the shard
+    partition (docs/DISTRIBUTED.md's duplication note) — the scan keeps
+    the count cheap for the one publishing worker, and every other
+    worker skips the pass entirely.
+    """
+    offsets: List[int] = []
+    if path.endswith(_FASTA_EXTS):
+        with _open(path) as f:
+            for line, _, off in _block_lines(f):
+                if line.startswith(b">"):
+                    offsets.append(off)
+    elif path.endswith(_FASTQ_EXTS):
+        with _open(path) as f:
+            lines = _block_lines(f)
+            while True:
+                header, _, rec_off = next(lines, (None, 0, 0))
+                if header is None:
+                    break
+                if not header:
+                    continue
+                if not header.startswith(b"@"):
+                    raise ParseError(
+                        f"[racon_tpu::io] error: malformed FASTQ file "
+                        f"{path}", offset=rec_off)
+                offsets.append(rec_off)
+                dlen = 0
+                while True:
+                    line, _, _ = next(lines, (None, 0, 0))
+                    if line is None:
+                        raise ParseError(
+                            f"[racon_tpu::io] error: truncated FASTQ "
+                            f"file {path} — EOF inside the record "
+                            f"starting", offset=rec_off)
+                    if line.startswith(b"+"):
+                        break
+                    dlen += len(line)
+                qlen = 0
+                while qlen < dlen:
+                    line, _, _ = next(lines, (None, 0, 0))
+                    if line is None:
+                        raise ParseError(
+                            f"[racon_tpu::io] error: truncated FASTQ "
+                            f"file {path} — EOF inside the record "
+                            f"starting", offset=rec_off)
+                    qlen += len(line)
+    else:
+        raise ParseError(
+            f"[racon_tpu::create_polisher] error: file {path} has "
+            "unsupported format extension (valid extensions: .fasta, "
+            ".fasta.gz, .fa, .fa.gz, .fastq, .fastq.gz, .fq, .fq.gz)!")
+    return len(offsets), offsets
+
+
 class FastaParser(Parser):
     def _records(self) -> Iterator[Tuple[Sequence, int]]:
         name: Optional[bytes] = None

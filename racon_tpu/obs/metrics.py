@@ -318,6 +318,36 @@ def ovl_extras(reg: Optional[MetricsRegistry] = None
     return out
 
 
+# --------------------------------------------------- wide-band redo
+
+def record_redo(device_windows: int, host_windows: int,
+                reg: Optional[MetricsRegistry] = None) -> None:
+    """Account one wide-band redo pass (ops/redo.py): ``device_windows``
+    flagged windows the on-device second pass resolved,
+    ``host_windows`` windows still unresolved after it (saturation
+    class, or certificate failure at the widened band) that fall back
+    to the host consensus. Zero host windows at bench geometry is the
+    acceptance criterion the redo smoke pins."""
+    reg = reg if reg is not None else _REGISTRY
+    reg.inc("redo_passes")
+    reg.inc("redo_device_windows", int(device_windows))
+    reg.inc("redo_host_windows", int(host_windows))
+
+
+def redo_extras(reg: Optional[MetricsRegistry] = None
+                ) -> Dict[str, object]:
+    """The registry's redo_* keys plus the ``walk_chain_len`` gauge as a
+    JSON-ready dict (bench extras metric_version 9 / obs_report "Redo"
+    section). ``walk_chain_len`` reports even when no redo fired — it is
+    the traceback critical-path gauge, set at every chunk dispatch."""
+    reg = reg if reg is not None else _REGISTRY
+    out: Dict[str, object] = {}
+    for k, v in sorted(reg.snapshot().items()):
+        if k.startswith("redo_") or k == "walk_chain_len":
+            out[k] = round(v, 4) if isinstance(v, float) else v
+    return out
+
+
 # ------------------------------------------------------ pipeline gauges
 
 def record_stage(name: str, busy_s: float, stall_in_s: float,

@@ -27,9 +27,21 @@ admitting the genome geometry the 1.6e9 literal rejected.
 VMEM admission for the band kernel's long-read tiles lives here too
 (:func:`vmem_est`), consumed by ovl_align's tile picker and bucket
 admission. tests/test_budget.py pins the boundary geometries.
+
+Round 8 adds the **nxt-k term**: at walk depth k=4 the band forwards
+emit a third plane, ``nxt2`` — uint16 cells packing the 2nd and 3rd
+predecessor hops — so the column walk undoes FOUR anchor positions per
+dependent gather. The u16 plane halves the admissible element count
+(constraint 2: ``max_dir_elems(2)``), so k is selected PER GEOMETRY by
+:func:`walk_k_for`: geometries whose plane would breach the u16 cap
+(the 8 kb genome overlap among them) degrade to the k=2 dual-column
+layout rather than being rejected. ``RACON_TPU_WALK_K`` (1/2/4,
+default 4) caps the selection; 2 reproduces the PR 5 behavior exactly.
 """
 
 from __future__ import annotations
+
+import os
 
 # Constraint (1): flat gather/scatter indices are int32 on device.
 INT32_INDEX_ELEMS = 2 ** 31
@@ -55,7 +67,7 @@ def max_dir_elems(cell_bytes: int = 1) -> int:
 VMEM_BUDGET = 12 * 1024 * 1024
 
 
-def vmem_est(W: int, Lq: int, ch: int) -> int:
+def vmem_est(W: int, Lq: int, ch: int, nxt_k: int = 2) -> int:
     """Band-kernel VMEM block-byte model at long-read geometry: the
     (W+Lq, 128) int32 target window (int16 would halve it, but Mosaic
     requires 8-aligned dynamic sublane slices below 32 bits), the
@@ -63,8 +75,50 @@ def vmem_est(W: int, Lq: int, ch: int) -> int:
     walk's second plane doubled this term), and four W-tall 128-lane i32
     rows (prev + packed NUC scratch + hlast + working row). Lane blocks
     always pad to 128 on TPU, so shrinking the batch below 128 lanes
-    saves nothing — ch and the admission cap are the only levers."""
-    return 128 * (4 * (W + Lq) + W * (4 * ch + 16))
+    saves nothing — ch and the admission cap are the only levers.
+
+    ``nxt_k >= 4`` adds the double-buffered (ch, W, 128) u16 ``nxt2``
+    block (2nd+3rd predecessor hops): +4*ch bytes per W lane-slot. The
+    k=2 default keeps every pre-round-8 admission decision byte-stable.
+    """
+    planes = 8 * ch if nxt_k >= 4 else 4 * ch
+    return 128 * (4 * (W + Lq) + W * (planes + 16))
+
+
+# --------------------------------------------------- walk depth (nxt-k)
+
+WALK_K_ENV = "RACON_TPU_WALK_K"
+
+
+def walk_k_env() -> int:
+    """The requested walk depth from ``RACON_TPU_WALK_K``: 4 (default,
+    quad-column), 2 (PR 5 dual-column), or 1 (single-step reference).
+    Anything else is a hard error — a typo silently degrading the walk
+    would be invisible until a profile regression."""
+    raw = os.environ.get(WALK_K_ENV, "").strip()
+    if not raw:
+        return 4
+    try:
+        k = int(raw)
+    except ValueError:
+        k = -1
+    if k not in (1, 2, 4):
+        raise ValueError(
+            f"[racon_tpu::budget] {WALK_K_ENV}={raw!r} invalid — "
+            "supported walk depths are 1, 2 and 4")
+    return k
+
+
+def walk_k_for(elems: int, env_k=None) -> int:
+    """Admissible walk depth for a geometry of ``elems`` cells per
+    plane: the env-requested k, degraded to 2 when the u16 ``nxt2``
+    plane would breach ``max_dir_elems(2)`` (the 2 GB single-buffer
+    ceiling at 2-byte cells). Degradation — not rejection — keeps every
+    k=2-admissible geometry on device; the chain is just longer there."""
+    k = walk_k_env() if env_k is None else int(env_k)
+    if k >= 4 and elems > max_dir_elems(2):
+        return 2
+    return k
 
 
 # ---------------------------------------------------------------------------
@@ -106,25 +160,29 @@ TILE_TIERS = (
 
 class TilePlan:
     """Admission result for one tiled overlap job: chunk geometry plus
-    the padded query length / tile count the dispatch will use."""
+    the padded query length / tile count the dispatch will use.
+    ``nxt_k`` is the per-tier walk depth (4 when the u16 nxt2 plane and
+    its VMEM block both fit this tier's geometry, else 2)."""
 
-    __slots__ = ("lanes", "W", "T", "ch", "Lq", "n_tiles")
+    __slots__ = ("lanes", "W", "T", "ch", "Lq", "n_tiles", "nxt_k")
 
-    def __init__(self, lanes, W, T, ch, Lq, n_tiles):
+    def __init__(self, lanes, W, T, ch, Lq, n_tiles, nxt_k=2):
         self.lanes = lanes
         self.W = W
         self.T = T
         self.ch = ch
         self.Lq = Lq
         self.n_tiles = n_tiles
+        self.nxt_k = nxt_k
 
     def key(self):
-        return (self.lanes, self.W, self.T, self.ch)
+        return (self.lanes, self.W, self.T, self.ch, self.nxt_k)
 
     def __repr__(self):  # pragma: no cover - debugging aid
-        return ("TilePlan(lanes=%d, W=%d, T=%d, ch=%d, Lq=%d, n_tiles=%d)"
+        return ("TilePlan(lanes=%d, W=%d, T=%d, ch=%d, Lq=%d, "
+                "n_tiles=%d, nxt_k=%d)"
                 % (self.lanes, self.W, self.T, self.ch, self.Lq,
-                   self.n_tiles))
+                   self.n_tiles, self.nxt_k))
 
 
 def tile_plan(lq: int, lt: int, tiers=None):
@@ -141,6 +199,11 @@ def tile_plan(lq: int, lt: int, tiers=None):
     * ``lanes * round_up(lq, T) * W <= max_dir_elems(1)`` — flat int32
       walk index / 2 GB buffer over the stitched dirs (and nxt) plane.
     * ``vmem_est(W, T, ch) <= VMEM_BUDGET`` — per-tile kernel blocks.
+
+    Admission itself is k-independent (a tier admitted at k=2 is never
+    lost to the deeper walk); the plan's ``nxt_k`` upgrades to 4 only
+    when the u16 nxt2 plane ALSO fits both the element and VMEM budgets
+    at this tier's geometry.
     """
     if tiers is None:
         tiers = TILE_TIERS
@@ -155,5 +218,8 @@ def tile_plan(lq: int, lt: int, tiers=None):
             continue
         if vmem_est(W, T, ch) > VMEM_BUDGET:
             continue
-        return TilePlan(lanes, W, T, ch, Lq, Lq // T)
+        nxt_k = walk_k_for(lanes * Lq * W)
+        if nxt_k >= 4 and vmem_est(W, T, ch, 4) > VMEM_BUDGET:
+            nxt_k = 2
+        return TilePlan(lanes, W, T, ch, Lq, Lq // T, max(nxt_k, 1))
     return None

@@ -48,8 +48,22 @@ from racon_tpu.ops.cigar import DIAG, UP, LEFT  # noqa: F401 (UP: doc)
 from racon_tpu.ops.flat import PAD_OP, U_SAT
 
 
+def chain_len(LA: int, k: int) -> int:
+    """Serialized dependent-gather count of the column walk at anchor
+    padding LA and walk depth k (1 = single-step, 2 = dual-column nxt
+    plane, 4 = quad-column nxt + nxt2 planes): ceil((LA + 2) / k)
+    positions-per-gather groups over the LA + 2 anchor positions. This
+    is the walk's HBM latency chain — the quantity the nxt planes
+    exist to divide (PROFILE.md rounds 5/8; bench ships it as the
+    ``walk_chain_len`` extra)."""
+    if k not in (1, 2, 4):
+        raise ValueError("[racon_tpu::colwalk] walk depth must be 1/2/4")
+    return -(-(int(LA) + 2) // int(k))
+
+
 def col_walk(cells, lq, lt, klo, t_off, *, LA: int, layout: str,
-             nxt=None, tile_klo=None, tile_len: int = 0, emit=None):
+             nxt=None, nxt2=None, tile_klo=None, tile_len: int = 0,
+             emit=None):
     """Walk packed cells over the anchor-position grid.
 
     Args:
@@ -70,9 +84,15 @@ def col_walk(cells, lq, lt, klo, t_off, *, LA: int, layout: str,
         PROFILE.md round 5's top remaining cost) halves. Bit-identical
         to the single-column walk for every lane the exactness
         certificates admit; flagged lanes (saturation / escape bound)
-        may emit differently but are re-polished on the host path in
+        may emit differently but are re-polished on the redo path in
         both modes (their ``sat``/escape flags themselves are
         identical).
+      nxt2: optional matching uint16 tensor of deep predecessor
+        metadata (band kernels' ``nxt_k=4`` plane): low byte packs hop
+        2's ``(up_run << 2 | consumer_dir)``, high byte hop 3's. With
+        both planes the walk undoes FOUR anchor positions per dependent
+        gather (the nxt/nxt2 reads share the cells gather's index, so
+        they ride the same dependent step). Requires ``nxt``.
       tile_klo: optional int32[n_tiles, B] per-TILE band origins from
         the tiled ultralong forward (ops/ovl_align.py): stored row r
         belongs to tile r // tile_len and its band slots map to target
@@ -104,8 +124,11 @@ def col_walk(cells, lq, lt, klo, t_off, *, LA: int, layout: str,
         Lq, B, W = cells.shape
     else:
         Lq, B, W = cells.shape           # W = Lt for flat layouts
+    if nxt2 is not None and nxt is None:
+        raise ValueError("[racon_tpu::colwalk] nxt2 requires nxt")
     c1 = cells.reshape(-1)
     n1 = None if nxt is None else nxt.reshape(-1)
+    n2_1 = None if nxt2 is None else nxt2.reshape(-1)
     lane = jnp.arange(B, dtype=jnp.int32)
     lt = lt.astype(jnp.int32)
     lq = lq.astype(jnp.int32)
@@ -189,6 +212,42 @@ def col_walk(cells, lq, lt, klo, t_off, *, LA: int, layout: str,
         i, sat, out_lo = undo(i, sat, p_hi - 1, u_lo, c_lo)
         return i, sat, out_hi, out_lo
 
+    def quad_substep(i, sat, p_hi):
+        # Positions p_hi .. p_hi - 3 off ONE dependent gather: the
+        # cells/nxt/nxt2 bytes at a single index give the gathered
+        # cell's own (u, cdir) plus hops 1-3 of its predecessor chain.
+        # Entry edge, generalized from dual_substep: position m (j_m =
+        # j_hi - m) is inactive while j_m > lt, so the FIRST active
+        # position is a = clip(j_hi - lt, 0, 3), the clipped gather
+        # already read cell (i, lt) — the byte position a's own gather
+        # would fetch — and position m > a needs hop m - a. Positions
+        # m < a are inactive (undo masks them; hop choice is
+        # don't-care), and once active the window stays active within
+        # the quad until j < 0 / the j == 0 finisher, both of which
+        # undo() forces without reading the hop data.
+        j = p_hi - t_off
+        jc = jnp.clip(j, 0, lt)
+        idx = cell_idx(i, jc)
+        pv = jnp.take(c1, idx).astype(jnp.int32)
+        nv = jnp.take(n1, idx).astype(jnp.int32)
+        n2v = jnp.take(n2_1, idx).astype(jnp.int32)
+        hops_u = (pv >> 4, nv >> 2, (n2v >> 2) & 0xF, (n2v >> 10) & 0xF)
+        hops_c = ((pv >> 2) & 3, nv & 3, n2v & 3, (n2v >> 8) & 3)
+        a = jnp.clip(j - lt, 0, 3)
+        outs = []
+        for m in range(4):
+            if m == 0:
+                u_m, c_m = hops_u[0], hops_c[0]
+            else:
+                hop = jnp.clip(m - a, 0, 3)
+                u_m, c_m = hops_u[min(m, 3)], hops_c[min(m, 3)]
+                for hh in range(min(m, 3) - 1, -1, -1):
+                    u_m = jnp.where(hop == hh, hops_u[hh], u_m)
+                    c_m = jnp.where(hop == hh, hops_c[hh], c_m)
+            i, sat, out = undo(i, sat, p_hi - m, u_m, c_m)
+            outs.append(out)
+        return i, sat, outs
+
     UNROLL = 4
 
     def step(carry, p0):
@@ -196,18 +255,22 @@ def col_walk(cells, lq, lt, klo, t_off, *, LA: int, layout: str,
         # chain of tiny per-column ops whose cost is per-iteration
         # dispatch overhead, not arithmetic — unrolling divides the
         # iteration count (PROFILE.md round 5). With the nxt plane, each
-        # iteration is UNROLL // 2 dependent gathers instead of UNROLL.
+        # iteration is UNROLL // 2 dependent gathers instead of UNROLL;
+        # with nxt2 as well, ONE dependent gather covers the whole
+        # iteration (PROFILE.md round 8).
         i, sat = carry
         outs = []
         if nxt is None:
             for k in reversed(range(UNROLL)):
                 i, sat, out = substep(i, sat, p0 + k)
                 outs.append(out)
-        else:
+        elif nxt2 is None:
             for k in (UNROLL - 1, UNROLL - 3):
                 i, sat, hi, lo = dual_substep(i, sat, p0 + k)
                 outs.append(hi)
                 outs.append(lo)
+        else:
+            i, sat, outs = quad_substep(i, sat, p0 + UNROLL - 1)
         # ONE stacked int16 ys, not a tuple of int16 arrays: a reverse
         # scan emitting a TUPLE of int16 ys miscompiles under XLA CPU jit
         # in jax 0.9 (wrong values vs disable_jit; int32 tuples and

@@ -366,7 +366,8 @@ def _packed_byte_slice(tab, start, L: int):
 
 
 def _lane_votes(bb, alen, begin, end, q, qw8, lq, w_read, win, *,
-                match, mismatch, gap, Lq, LA, pallas, band_w=0):
+                match, mismatch, gap, Lq, LA, pallas, band_w=0,
+                nxt_k=2):
     """Job geometry + NW forward + column-walk + vote extraction for
     every lane of one refinement round (traced body, one shard's view).
 
@@ -375,10 +376,16 @@ def _lane_votes(bb, alen, begin, end, q, qw8, lq, w_read, win, *,
     (racon_tpu/sched/rounds.py) both consume its output, so the two
     dispatch paths run one implementation of the alignment contract.
 
+    ``nxt_k`` (static; 2 or 4) selects the banded walk's predecessor
+    depth — at 4 the forward also emits the u16 ``nxt2`` hop plane and
+    the column walk undoes four anchor positions per dependent gather
+    (budget.walk_k_for picks it per geometry; the flat path has no nxt
+    plane and ignores it).
+
     Returns (votes dict of per-job channels for dm.aggregate_votes,
     esc_w f32[B] — positive where the banded walk's exactness
     certificate failed and the lane's window must re-polish on the
-    unbounded host path).
+    redo path).
     """
     import jax
     import jax.numpy as jnp
@@ -428,11 +435,20 @@ def _lane_votes(bb, alen, begin, end, q, qw8, lq, w_read, win, *,
         sl = _packed_byte_slice(tab, start, PW)
         tband = jnp.where(okb, sl, 7).astype(jnp.uint8)
         fwd = fw_dirs_band if pallas else fw_dirs_band_xla
-        dirs, nxt, hlast = fwd(tband, q.T, klo, lq,
-                               match=match, mismatch=mismatch, gap=gap,
-                               W=band_w)
+        if nxt_k >= 4:
+            dirs, nxt, nxt2, hlast = fwd(tband, q.T, klo, lq,
+                                         match=match, mismatch=mismatch,
+                                         gap=gap, W=band_w, nxt_k=4)
+        else:
+            dirs, nxt, hlast = fwd(tband, q.T, klo, lq,
+                                   match=match, mismatch=mismatch,
+                                   gap=gap, W=band_w)
+            nxt2 = None
+            if nxt_k < 2:           # single-step reference walk
+                nxt = None
         cols = col_walk(dirs, lq, lt, klo, t_off, LA=LA,
-                        layout="band_t" if pallas else "band", nxt=nxt)
+                        layout="band_t" if pallas else "band", nxt=nxt,
+                        nxt2=nxt2)
         # Escape bound (see nw.cpp): banded score must beat any path
         # that leaves the band, else the lane's window is re-polished on
         # the unbounded host path. Any out-of-band path carries at least
@@ -505,7 +521,8 @@ def _remap_state(codes, total, map_b, map_e, bb, alen, begin, end, win,
 
 def _round_core(bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf, *,
                 match, mismatch, gap, ins_scale, Lq, n_win,
-                LA, pallas, band_w=0, detect=False, axis_name=None):
+                LA, pallas, band_w=0, nxt_k=2, detect=False,
+                axis_name=None):
     """One alignment + merge round (traced body, single shard's view).
 
     Returns (new_bb, new_bbw, new_alen, new_begin, new_end, cov, ovf,
@@ -530,7 +547,7 @@ def _round_core(bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf, *,
     votes, esc_w = _lane_votes(
         bb, alen, begin, end, q, qw8, lq, w_read, win, match=match,
         mismatch=mismatch, gap=gap, Lq=Lq, LA=LA, pallas=pallas,
-        band_w=band_w)
+        band_w=band_w, nxt_k=nxt_k)
     # The band-escape per-window sum rides aggregate_votes' membership
     # matrix and the same single psum as the votes.
     acc = dm.aggregate_votes(votes, win, n_win + 1, extras={"_esc": esc_w})
@@ -568,7 +585,7 @@ def _round_core(bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf, *,
 device_round = functools.partial(
     __import__("jax").jit,
     static_argnames=("match", "mismatch", "gap", "ins_scale", "Lq",
-                     "n_win", "LA", "pallas", "band_w",
+                     "n_win", "LA", "pallas", "band_w", "nxt_k",
                      "detect"))(_round_core)
 
 
@@ -593,7 +610,7 @@ def round_band_width(band_w: int, r: int) -> int:
 
 
 def _make_round_fn(*, match, mismatch, gap, ins_scale, Lq, n_win, LA,
-                   pallas, band_w, mesh, detect=False):
+                   pallas, band_w, mesh, nxt_k=2, detect=False):
     """One round callable: plain _round_core, or its dp-sharded shard_map
     when a mesh is given (the single place the sharding contract lives).
 
@@ -606,7 +623,7 @@ def _make_round_fn(*, match, mismatch, gap, ins_scale, Lq, n_win, LA,
     core = functools.partial(
         _round_core, match=match, mismatch=mismatch, gap=gap,
         ins_scale=ins_scale, Lq=Lq, n_win=n_win, LA=LA, pallas=pallas,
-        band_w=band_w, detect=detect,
+        band_w=band_w, nxt_k=nxt_k, detect=detect,
         axis_name=None if mesh is None else "dp")
     if mesh is None:
         return core
@@ -656,10 +673,10 @@ def _unpack_bufs(job_buf, win_buf, Lq: int, LA: int):
     __import__("jax").jit,
     static_argnames=("match", "mismatch", "gap", "ins_scale", "Lq",
                      "n_win", "LA", "pallas", "band_w", "rounds",
-                     "adaptive", "mesh"))
+                     "adaptive", "mesh", "nxt_k"))
 def device_chunk_packed(job_buf, win_buf, *, match, mismatch, gap,
                         ins_scale, Lq, n_win, LA, pallas, band_w, rounds,
-                        adaptive=False, mesh=None):
+                        adaptive=False, mesh=None, nxt_k=2):
     """One chunk end to end in ONE jit dispatch from TWO byte buffers.
 
     Inputs arrive as ChunkPlan.packed_bufs()' concatenated layouts (two
@@ -703,7 +720,7 @@ def device_chunk_packed(job_buf, win_buf, *, match, mismatch, gap,
         return _make_round_fn(
             match=match, mismatch=mismatch, gap=gap, ins_scale=sc,
             Lq=Lq, n_win=n_win, LA=LA, pallas=pallas, band_w=bw,
-            mesh=mesh, detect=det)
+            mesh=mesh, nxt_k=nxt_k, detect=det)
 
     if not adaptive:
         for r in range(rounds):
@@ -752,10 +769,10 @@ def device_chunk_packed(job_buf, win_buf, *, match, mismatch, gap,
 @functools.partial(
     __import__("jax").jit,
     static_argnames=("match", "mismatch", "gap", "ins_scale", "Lq",
-                     "n_win", "LA", "pallas", "band_w", "mesh"))
+                     "n_win", "LA", "pallas", "band_w", "mesh", "nxt_k"))
 def device_round_sharded(bb, bbw, alen, begin, end, q, qw8, lq, w_read,
                          win, ovf, *, match, mismatch, gap, ins_scale, Lq,
-                         n_win, LA, pallas, band_w, mesh):
+                         n_win, LA, pallas, band_w, mesh, nxt_k=2):
     """device_round with the job axis sharded over the mesh's "dp" axis.
 
     Window arrays (anchors, lengths, ovf) stay replicated; each chip
@@ -766,7 +783,7 @@ def device_round_sharded(bb, bbw, alen, begin, end, q, qw8, lq, w_read,
     fn = _make_round_fn(
         match=match, mismatch=mismatch, gap=gap, ins_scale=ins_scale,
         Lq=Lq, n_win=n_win, LA=LA, pallas=pallas, band_w=band_w,
-        mesh=mesh)
+        mesh=mesh, nxt_k=nxt_k)
     return fn(bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf)
 
 
@@ -878,7 +895,16 @@ def dispatch_chunk(plan: ChunkPlan, *, match: int, mismatch: int,
     pallas = _use_pallas(plan.B // ndp, plan.Lq, plan.LA)
     band_w = (0 if os.environ.get("RACON_TPU_NO_BAND", "")
               not in ("", "0", "false") else plan.band_w)
+    # Walk depth for this chunk's banded forwards. Selected at the
+    # round-0 (widest) band so every round of the chunk shares one k:
+    # the k=4 nxt2 plane must fit the element budget at the largest
+    # per-round geometry. The flat fallback has no nxt planes at all.
+    from racon_tpu.ops.budget import walk_k_for
+    nxt_k = walk_k_for(plan.B // ndp * plan.Lq * band_w) if band_w else 1
+    from racon_tpu.ops.colwalk import chain_len
     from racon_tpu.obs.metrics import record_h2d, registry as obs_registry
+    obs_registry().set("walk_chain_len",
+                       chain_len(plan.LA, nxt_k if band_w else 1))
     t0 = time.perf_counter()
     if not verbose:
         # Production path: TWO h2d byte buffers, then the whole chunk
@@ -908,7 +934,7 @@ def dispatch_chunk(plan: ChunkPlan, *, match: int, mismatch: int,
             match=match, mismatch=mismatch, gap=gap, ins_scale=ins_scale,
             Lq=plan.Lq, n_win=plan.n_win, LA=plan.LA,
             pallas=pallas, band_w=band_w, rounds=rounds,
-            adaptive=adaptive, mesh=mesh)
+            adaptive=adaptive, mesh=mesh, nxt_k=nxt_k)
         obs_registry().inc("device_dispatches")
         if collect:
             t0 = sync(packed, "compute", t0)
@@ -947,7 +973,7 @@ def dispatch_chunk(plan: ChunkPlan, *, match: int, mismatch: int,
             match=match, mismatch=mismatch, gap=gap,
             ins_scale=scales[r], Lq=plan.Lq, n_win=plan.n_win,
             LA=plan.LA, pallas=pallas,
-            band_w=round_band_width(band_w, r))
+            band_w=round_band_width(band_w, r), nxt_k=nxt_k)
         obs_registry().inc("device_dispatches")
         t0 = sync(cov, f"compute/round{r}", t0)
     if stats is not None:

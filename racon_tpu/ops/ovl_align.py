@@ -56,14 +56,16 @@ from racon_tpu.ops.budget import (VMEM_BUDGET as _VMEM_BUDGET,
 MAX_DIR_ELEMS = max_dir_elems(1)
 
 
-def _pick_tiles(W: int, Lq: int) -> Tuple[int, int]:
+def _pick_tiles(W: int, Lq: int, nxt_k: int = 2) -> Tuple[int, int]:
     """(tb, ch) for the band kernel: full 128 lanes, row tile shrunk
     until the VMEM model fits (admission guarantees ch=4 fits; the ch=4
     tier exists because the dual-column nxt plane's block doubled the
     row-tile term and would otherwise evict the 8 kb genome geometry
-    that fit at ch=8 — see budget.vmem_est)."""
+    that fit at ch=8 — see budget.vmem_est). ``nxt_k >= 4`` adds the u16
+    nxt2 block to the model (the caller degrades k, not ch, when even
+    ch=4 cannot host it)."""
     for ch in (32, 8, 4):
-        if Lq % ch == 0 and _vmem_est(W, Lq, ch) <= _VMEM_BUDGET:
+        if Lq % ch == 0 and _vmem_est(W, Lq, ch, nxt_k) <= _VMEM_BUDGET:
             return TB, ch
     return TB, 4
 
@@ -84,9 +86,9 @@ def band_width_for_read(lq: int, lt: int) -> int:
 @functools.partial(
     __import__("jax").jit,
     static_argnames=("match", "mismatch", "gap", "W", "w_len", "NW", "Lq",
-                     "LA", "pallas"))
+                     "LA", "pallas", "nxt_k"))
 def _chunk_breaking_points(q, t, lq, lt, t_begin, *, match, mismatch, gap,
-                           W, w_len, NW, Lq, LA, pallas):
+                           W, w_len, NW, Lq, LA, pallas, nxt_k=2):
     """One device chunk: banded forward + column walk + per-window
     first/last-match reduction.
 
@@ -120,16 +122,22 @@ def _chunk_breaking_points(q, t, lq, lt, t_begin, *, match, mismatch, gap,
     tband = jnp.where(okb, sl, 7).astype(jnp.uint8)
 
     if pallas:
-        tb, ch = _pick_tiles(W, Lq)
-        dirs, nxt, hlast = fw_dirs_band(
-            tband, q.T, klo, lq, match=match, mismatch=mismatch, gap=gap,
-            W=W, tb=tb, ch=ch)
+        tb, ch = _pick_tiles(W, Lq, nxt_k)
+        fwd = functools.partial(fw_dirs_band, tb=tb, ch=ch)
     else:
-        dirs, nxt, hlast = fw_dirs_band_xla(
+        fwd = fw_dirs_band_xla
+    if nxt_k >= 4:
+        dirs, nxt, nxt2, hlast = fwd(
+            tband, q.T, klo, lq, match=match, mismatch=mismatch, gap=gap,
+            W=W, nxt_k=4)
+    else:
+        dirs, nxt, hlast = fwd(
             tband, q.T, klo, lq, match=match, mismatch=mismatch, gap=gap,
             W=W)
+        nxt2 = None
     cols = col_walk(dirs, lq, lt, klo, jnp.zeros(B, jnp.int32), LA=LA,
-                    layout="band_t" if pallas else "band", nxt=nxt)
+                    layout="band_t" if pallas else "band", nxt=nxt,
+                    nxt2=nxt2)
 
     # Tightened escape bound (same derivation as device_poa._round_core).
     xend = jnp.clip(lt - lq - klo, 0, W - 1)
@@ -165,10 +173,10 @@ def _chunk_breaking_points(q, t, lq, lt, t_begin, *, match, mismatch, gap,
 @functools.partial(
     __import__("jax").jit,
     static_argnames=("match", "mismatch", "gap", "W", "w_len", "NW", "Lq",
-                     "LA", "T", "tb", "ch", "pallas"))
+                     "LA", "T", "tb", "ch", "pallas", "nxt_k"))
 def _tiled_chunk_breaking_points(q, t, lq, lt, t_begin, *, match, mismatch,
                                  gap, W, w_len, NW, Lq, LA, T, tb, ch,
-                                 pallas):
+                                 pallas, nxt_k=2):
     """One ULTRALONG device chunk: lax.scan over query-axis tiles of the
     frontier-carrying band kernel, then one stitched column walk.
 
@@ -219,7 +227,9 @@ def _tiled_chunk_breaking_points(q, t, lq, lt, t_begin, *, match, mismatch,
     import jax.numpy as jnp
     from racon_tpu.ops.colwalk import col_walk
     from racon_tpu.ops.pallas.band_kernel import (
-        UC_BOUNDARY, fw_dirs_band_tile, fw_dirs_band_xla_tile)
+        fw_dirs_band_tile, fw_dirs_band_xla_tile, uc_boundary)
+
+    BND = uc_boundary(nxt_k)
 
     B = q.shape[0]
     n_tiles = Lq // T
@@ -236,7 +246,7 @@ def _tiled_chunk_breaking_points(q, t, lq, lt, t_begin, *, match, mismatch,
     klo0 = jnp.clip(jnp.minimum(0, delta) - wl, klo_lo, klo_hi)
     j00 = klo0[:, None] + xr
     prev0 = jnp.where(j00 >= 0, j00 * gap, NEG).astype(jnp.int32)
-    uc0 = jnp.full((B, W), UC_BOUNDARY, jnp.int32)
+    uc0 = jnp.full((B, W), BND, jnp.int32)
     hl0 = prev0
 
     PW = W + T
@@ -261,13 +271,18 @@ def _tiled_chunk_breaking_points(q, t, lq, lt, t_begin, *, match, mismatch,
         qT_t = jax.lax.dynamic_slice_in_dim(qT, i0, T, axis=0)
         i0v = jnp.full((B,), i0, jnp.int32)
         if pallas:
-            dirs, nxt, hl2, prev2, uc2 = fw_dirs_band_tile(
-                tband, qT_t, klo, lq, i0v, prev, uc, hl, match=match,
-                mismatch=mismatch, gap=gap, W=W, tb=tb, ch=ch)
+            fwd = functools.partial(fw_dirs_band_tile, tb=tb, ch=ch)
         else:
-            dirs, nxt, hl2, prev2, uc2 = fw_dirs_band_xla_tile(
+            fwd = fw_dirs_band_xla_tile
+        if nxt_k >= 4:
+            dirs, nxt, nxt2, hl2, prev2, uc2 = fwd(
+                tband, qT_t, klo, lq, i0v, prev, uc, hl, match=match,
+                mismatch=mismatch, gap=gap, W=W, nxt_k=4)
+        else:
+            dirs, nxt, hl2, prev2, uc2 = fwd(
                 tband, qT_t, klo, lq, i0v, prev, uc, hl, match=match,
                 mismatch=mismatch, gap=gap, W=W)
+            nxt2 = None
         # Dead-zone re-centering on the frontier argmax (step 4 above).
         xstar = jnp.argmax(prev2, axis=1).astype(jnp.int32)
         shift = jnp.where(xstar < W // 4, xstar - W // 4,
@@ -281,28 +296,32 @@ def _tiled_chunk_breaking_points(q, t, lq, lt, t_begin, *, match, mismatch,
         prev3 = jnp.where(
             okx, jnp.take_along_axis(prev2, xig, axis=1), NEG)
         uc3 = jnp.where(
-            okx, jnp.take_along_axis(uc2, xig, axis=1), UC_BOUNDARY)
+            okx, jnp.take_along_axis(uc2, xig, axis=1), BND)
         hl3 = jnp.where(
             okx, jnp.take_along_axis(hl2, xig, axis=1), NEG)
-        return (prev3, uc3, hl3, klo_n, cmin), (dirs, nxt, klo)
+        ys = (dirs, nxt, nxt2, klo) if nxt_k >= 4 else (dirs, nxt, klo)
+        return (prev3, uc3, hl3, klo_n, cmin), ys
 
     i0s = jnp.arange(n_tiles, dtype=jnp.int32) * T
     carry0 = (prev0, uc0, hl0, klo0,
               jnp.full(klo0.shape, 2 ** 30, jnp.int32))
-    (_, _, hlF, kloF, cmin), (dslab, nslab, klos) = jax.lax.scan(
-        tile_body, carry0, i0s)
+    if nxt_k >= 4:
+        (_, _, hlF, kloF, cmin), (dslab, nslab, n2slab, klos) = \
+            jax.lax.scan(tile_body, carry0, i0s)
+    else:
+        (_, _, hlF, kloF, cmin), (dslab, nslab, klos) = jax.lax.scan(
+            tile_body, carry0, i0s)
+        n2slab = None
     # Stacked per-tile slabs ARE the whole-read tensors: [n_tiles, T,
     # W, B] -> [Lq, W, B] (kernel layout; twin analogous) with rows in
     # global order.
-    if pallas:
-        cells = dslab.reshape(Lq, W, B)
-        nxtp = nslab.reshape(Lq, W, B)
-    else:
-        cells = dslab.reshape(Lq, B, W)
-        nxtp = nslab.reshape(Lq, B, W)
+    shape = (Lq, W, B) if pallas else (Lq, B, W)
+    cells = dslab.reshape(shape)
+    nxtp = nslab.reshape(shape)
+    nxt2p = None if n2slab is None else n2slab.reshape(shape)
     cols = col_walk(cells, lq, lt, None, jnp.zeros(B, jnp.int32), LA=LA,
                     layout="band_t" if pallas else "band", nxt=nxtp,
-                    tile_klo=klos, tile_len=T, emit=jnp.int32)
+                    nxt2=nxt2p, tile_klo=klos, tile_len=T, emit=jnp.int32)
 
     # hlF rides the frontier shifts, so the terminal cell is indexed
     # through the FINAL origin; the clamp proof keeps it in [0, W).
@@ -440,11 +459,11 @@ def device_breaking_points(pending, sequences, window_length: int, *,
     bytier = {}
     for j in tiled_jobs:
         bytier.setdefault(j[4].key(), []).append(j)
-    for (lanes, W_t, T_t, ch_t), js in sorted(bytier.items()):
+    for (lanes, W_t, T_t, ch_t, k_t), js in sorted(bytier.items()):
         js.sort(key=lambda j: (len(j[1]), len(j[2])))
         Lq_t = max(_round_up(len(j[1]), T_t) for j in js)
         LA_t = max(Lq_t, max(_round_up(len(j[2]), 2048) for j in js))
-        tiled_buckets.append((js, lanes, W_t, T_t, ch_t, Lq_t, LA_t))
+        tiled_buckets.append((js, lanes, W_t, T_t, ch_t, Lq_t, LA_t, k_t))
 
     # Dispatch every chunk before collecting any: jit calls are async,
     # so chunk i+1's h2d overlaps chunk i's compute (the tunnel's h2d
@@ -454,7 +473,15 @@ def device_breaking_points(pending, sequences, window_length: int, *,
     verbose = os.environ.get("RACON_TPU_TIMING", "") not in ("", "0")
     t_disp = _time.perf_counter()
     pending_out = []
+    from racon_tpu.ops.budget import walk_k_for
     for bucket, Lq, LA, W in buckets:
+        # Per-bucket walk depth: the u16 nxt2 plane must fit the element
+        # cap at the BUCKET's padded geometry (walk_k_for degrades the
+        # 8 kb genome overlaps to the dual-column walk) and its VMEM
+        # block the smallest row tile.
+        nxt_k = walk_k_for(TB * Lq * W)
+        if nxt_k >= 4 and _vmem_est(W, Lq, 4, 4) > _VMEM_BUDGET:
+            nxt_k = 2
         NW = LA // window_length + 2
         B = TB
         for s in range(0, len(bucket), B):
@@ -474,10 +501,10 @@ def device_breaking_points(pending, sequences, window_length: int, *,
                 pending_out.append((sub, _chunk_breaking_points(
                     q, t, lq, lt, t_begin, match=match, mismatch=mismatch,
                     gap=gap, W=W, w_len=window_length, NW=NW, Lq=Lq, LA=LA,
-                    pallas=pallas)))
+                    pallas=pallas, nxt_k=nxt_k)))
 
     n_tiles_exec = 0
-    for bucket, lanes, W, T, ch, Lq, LA in tiled_buckets:
+    for bucket, lanes, W, T, ch, Lq, LA, nxt_k in tiled_buckets:
         NW = LA // window_length + 2
         n_tiles = Lq // T
         for s in range(0, len(bucket), lanes):
@@ -506,7 +533,8 @@ def device_breaking_points(pending, sequences, window_length: int, *,
                 pending_out.append((sub, _tiled_chunk_breaking_points(
                     q, t, lq, lt, t_begin, match=match, mismatch=mismatch,
                     gap=gap, W=W, w_len=window_length, NW=NW, Lq=Lq,
-                    LA=LA, T=T, tb=B, ch=ch, pallas=pallas)))
+                    LA=LA, T=T, tb=B, ch=ch, pallas=pallas,
+                    nxt_k=nxt_k)))
             n_tiles_exec += n_tiles
 
     if verbose:

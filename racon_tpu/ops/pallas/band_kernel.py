@@ -58,6 +58,19 @@ CH = 32    # query rows per grid step
 from racon_tpu.ops.flat import U_SAT  # single source (= K_INS + 1)
 
 
+def uc_boundary(nxt_k: int = 2) -> int:
+    """Packed row-0 / out-of-band frontier fill for a ``nxt_k``-deep
+    predecessor plane: every 6-bit hop field (and the base (U, C) pair)
+    decodes as (up_run 0, consumer LEFT) — the values the walk is forced
+    to at the matrix boundary anyway. k=2 packs ``(N1 << 6) | (U << 2) |
+    C`` (12 bits, the PR 5 layout); k=4 extends to ``(N3 << 18) |
+    (N2 << 12) | (N1 << 6) | (U << 2) | C`` (24 bits)."""
+    v = LEFT
+    for _ in range(max(int(nxt_k) - 1, 1)):
+        v = (v << 6) | LEFT
+    return v
+
+
 def _score_dtype(match: int, mismatch: int, gap: int, Lq: int, W: int):
     """int16 when every DP intermediate provably fits, else int32.
 
@@ -80,15 +93,20 @@ def _score_dtype(match: int, mismatch: int, gap: int, Lq: int, W: int):
     return jnp.int32
 
 
-def _kernel(tbandT_ref, qT_ref, klo_ref, lq_ref, dirs_ref, nxt_ref,
-            hlast_ref, prev_ref, ucprev_ref, *, match, mismatch, gap, W,
-            dtype, TB, CH):
+def _kernel(tbandT_ref, qT_ref, klo_ref, lq_ref, *refs, match, mismatch,
+            gap, W, dtype, TB, CH, nxt_k=2):
     # Transposed layout: band slots x on SUBLANES, jobs on LANES. The
     # per-row moving target window is then a dynamic *sublane* slice
     # (supported by Mosaic at any offset), where the lane-major variant
     # would need a 128-aligned dynamic lane slice (rejected).
+    if nxt_k >= 4:
+        dirs_ref, nxt_ref, nxt2_ref, hlast_ref, prev_ref, ucprev_ref = refs
+    else:
+        dirs_ref, nxt_ref, hlast_ref, prev_ref, ucprev_ref = refs
+        nxt2_ref = None
     c = pl.program_id(1)
     NEG = _NEG16 if dtype == jnp.int16 else _NEG   # Python int: inlines
+    BND = uc_boundary(nxt_k)               # Python int: inlines
     xr = jax.lax.broadcasted_iota(jnp.int32, (W, TB), 0)
     klo = klo_ref[0]                       # [TB] int32
     lqv = lq_ref[0]                        # [TB] int32
@@ -104,12 +122,13 @@ def _kernel(tbandT_ref, qT_ref, klo_ref, lq_ref, dirs_ref, nxt_ref,
         # UP-chain metadata boundary (row 0): no UP can start above row 1,
         # and a chain that reaches row 0 is consumed by the forced LEFT
         # walk along the top row — encode that as consumer dir LEFT.
-        # N, U and C share one packed scratch (N << 6 | U << 2 | C): a
-        # long-read overlap chunk's VMEM budget is tight (ovl_align), and
+        # N, U and C share one packed scratch (N << 6 | U << 2 | C,
+        # extended by the N2/N3 hop fields at nxt_k=4): a long-read
+        # overlap chunk's VMEM budget is tight (ovl_align), and
         # separate buffers cost another (W, TB) i32 block each. Row-0 N
         # is (U=0, C=LEFT) — the walk's forced top-row values — matching
         # what a reader at row 0 would be forced to anyway.
-        ucprev_ref[:] = jnp.full((W, TB), (LEFT << 6) | LEFT, jnp.int32)
+        ucprev_ref[:] = jnp.full((W, TB), BND, jnp.int32)
 
     def row(r, _):
         i = c * CH + r + 1                 # 1-based global row
@@ -161,30 +180,50 @@ def _kernel(tbandT_ref, qT_ref, klo_ref, lq_ref, dirs_ref, nxt_ref,
         isup = d == UP
         ucp = ucprev_ref[:]
         ucup = jnp.concatenate(
-            [ucp[1:, :], jnp.full((1, TB), (LEFT << 6) | LEFT, jnp.int32)],
+            [ucp[1:, :], jnp.full((1, TB), BND, jnp.int32)],
             axis=0)
         U = jnp.where(isup, jnp.minimum(((ucup >> 2) & 0xF) + 1, U_SAT), 0)
         C = jnp.where(isup, ucup & 3, d)
-        # Dual-column metadata (the second output plane): N = the packed
-        # (U' << 2 | C') of the PREDECESSOR cell the walk visits after
-        # undoing this cell's [UP run][consumer] block — cell
-        # (i - U - (C==DIAG), j - 1). One gather then undoes TWO target
-        # columns (docs/KERNELS.md). Propagation is three static shifts:
-        #   UP:   inherit from the cell above (same predecessor — the
-        #         whole chain shares its chain top's undo target),
-        #   DIAG: predecessor is (i-1, j-1) = prev row, same slot,
-        #   LEFT: predecessor is (i, j-1) = this row, slot x-1 (U and C
-        #         are finalized for the whole row before this select).
+        # k-step predecessor metadata (the extra output planes): hop
+        # field m is uc_m = the packed (U' << 2 | C') of pred^m — the
+        # cell the walk visits after undoing m [UP run][consumer]
+        # blocks, where pred^1 of (i, j) is (i - U - (C==DIAG), j - 1).
+        # One gather then undoes nxt_k target columns (docs/KERNELS.md).
+        # Each hop propagates by the same three static shifts, reading
+        # the PREVIOUS hop's field (uc_m(cell) = uc_{m-1}(pred^1(cell))):
+        #   UP:   inherit field m from the cell above (the whole chain
+        #         shares its chain top's undo target, so pred^1 — and
+        #         hence every deeper pred — is chain-invariant),
+        #   DIAG: predecessor is (i-1, j-1) = prev row, same slot, so
+        #         field m comes from the prev row's field m-1,
+        #   LEFT: predecessor is (i, j-1) = this row, slot x-1: shift of
+        #         this row's just-finalized field m-1 (U and C are
+        #         finalized for the whole row before these selects).
         # Slot-0 LEFT reads a boundary fill — out-of-band predecessors
-        # only occur on paths that fail the escape bound (host redo).
+        # only occur on paths that fail the escape bound (redo route).
         ucnow = (U << 2) + C
         nleft = jnp.concatenate(
             [jnp.full((1, TB), LEFT, jnp.int32), ucnow[:-1, :]], axis=0)
-        N = jnp.where(isup, ucup >> 6,
+        N = jnp.where(isup, (ucup >> 6) & 0x3F,
                       jnp.where(d == DIAG, ucp & 0x3F, nleft))
         dirs_ref[r] = (d + (C << 2) + (U << 4)).astype(jnp.uint8)
         nxt_ref[r] = N.astype(jnp.uint8)
-        ucprev_ref[:] = (N << 6) + ucnow
+        if nxt_k >= 4:
+            n1left = jnp.concatenate(
+                [jnp.full((1, TB), LEFT, jnp.int32), N[:-1, :]], axis=0)
+            N2 = jnp.where(isup, (ucup >> 12) & 0x3F,
+                           jnp.where(d == DIAG, (ucp >> 6) & 0x3F, n1left))
+            n2left = jnp.concatenate(
+                [jnp.full((1, TB), LEFT, jnp.int32), N2[:-1, :]], axis=0)
+            N3 = jnp.where(isup, (ucup >> 18) & 0x3F,
+                           jnp.where(d == DIAG, (ucp >> 12) & 0x3F, n2left))
+            # u16 plane: hop 2 in the low byte, hop 3 in the high byte
+            # (byte-aligned so the walk decodes without cross-byte
+            # shifts beyond one >> 8).
+            nxt2_ref[r] = ((N3 << 8) + N2).astype(jnp.uint16)
+            ucprev_ref[:] = (N3 << 18) + (N2 << 12) + (N << 6) + ucnow
+        else:
+            ucprev_ref[:] = (N << 6) + ucnow
         prev_ref[:] = h
         # Capture each lane's true final row as the row counter passes it.
         hlast_ref[:] = jnp.where((lqv == i)[None, :], h, hlast_ref[:])
@@ -195,11 +234,11 @@ def _kernel(tbandT_ref, qT_ref, klo_ref, lq_ref, dirs_ref, nxt_ref,
 
 @functools.partial(jax.jit,
                    static_argnames=("match", "mismatch", "gap", "W",
-                                    "tb", "ch", "interpret"))
+                                    "tb", "ch", "interpret", "nxt_k"))
 def fw_dirs_band(tband: jnp.ndarray, qT: jnp.ndarray, klo: jnp.ndarray,
                  lq: jnp.ndarray, *, match: int, mismatch: int, gap: int,
                  W: int, tb: int = TB, ch: int = CH,
-                 interpret: bool = False):
+                 interpret: bool = False, nxt_k: int = 2):
     """Banded packed-cell tensors + final-row scores (Pallas, transposed).
 
     Args:
@@ -217,7 +256,10 @@ def fw_dirs_band(tband: jnp.ndarray, qT: jnp.ndarray, klo: jnp.ndarray,
     ``consumer_dir | up_run << 2`` so one traceback gather undoes TWO
     target columns (see racon_tpu/ops/colwalk.py for the walk and
     docs/KERNELS.md for the contract; the plain direction is the low 2
-    bits of the cell byte). B % tb == 0, Lq % ch == 0 required.
+    bits of the cell byte). With ``nxt_k=4`` a THIRD plane rides along —
+    ``nxt2`` uint16[Lq, W, B] packing hops 2 and 3 (low/high byte) so
+    one gather undoes FOUR target columns; the return becomes
+    (cells, nxt, nxt2, hlast). B % tb == 0, Lq % ch == 0 required.
     ``tb``/``ch`` tile the lane/row grid: the defaults suit
     consensus-window shapes; long-read overlap alignment (W in the
     thousands, racon_tpu/ops/ovl_align.py) passes smaller tiles so the
@@ -231,8 +273,20 @@ def fw_dirs_band(tband: jnp.ndarray, qT: jnp.ndarray, klo: jnp.ndarray,
     Lq = qT.shape[0]
     dtype = _score_dtype(match, mismatch, gap, Lq, W)
     kernel = functools.partial(_kernel, match=match, mismatch=mismatch,
-                               gap=gap, W=W, dtype=dtype, TB=tb, CH=ch)
-    dirs, nxt, hlast = pl.pallas_call(
+                               gap=gap, W=W, dtype=dtype, TB=tb, CH=ch,
+                               nxt_k=nxt_k)
+    plane_spec = pl.BlockSpec((ch, W, tb), lambda b, c: (c, 0, b),
+                              memory_space=pltpu.VMEM)
+    out_specs = [plane_spec, plane_spec]
+    out_shape = [jax.ShapeDtypeStruct((Lq, W, B), jnp.uint8),
+                 jax.ShapeDtypeStruct((Lq, W, B), jnp.uint8)]
+    if nxt_k >= 4:
+        out_specs.append(plane_spec)
+        out_shape.append(jax.ShapeDtypeStruct((Lq, W, B), jnp.uint16))
+    out_specs.append(pl.BlockSpec((W, tb), lambda b, c: (0, b),
+                                  memory_space=pltpu.VMEM))
+    out_shape.append(jax.ShapeDtypeStruct((W, B), dtype))
+    outs = pl.pallas_call(
         kernel,
         grid=(B // tb, Lq // ch),
         in_specs=[
@@ -245,19 +299,8 @@ def fw_dirs_band(tband: jnp.ndarray, qT: jnp.ndarray, klo: jnp.ndarray,
             pl.BlockSpec((1, tb), lambda b, c: (0, b),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=[
-            pl.BlockSpec((ch, W, tb), lambda b, c: (c, 0, b),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((ch, W, tb), lambda b, c: (c, 0, b),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((W, tb), lambda b, c: (0, b),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((Lq, W, B), jnp.uint8),
-            jax.ShapeDtypeStruct((Lq, W, B), jnp.uint8),
-            jax.ShapeDtypeStruct((W, B), dtype),
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[pltpu.VMEM((W, tb), dtype),
                         pltpu.VMEM((W, tb), jnp.int32)],
         compiler_params=_CompilerParams(
@@ -265,17 +308,23 @@ def fw_dirs_band(tband: jnp.ndarray, qT: jnp.ndarray, klo: jnp.ndarray,
         interpret=interpret,
     )(tband.astype(jnp.int32).T, qT.astype(jnp.int32),
       klo[None, :], lq[None, :])
+    if nxt_k >= 4:
+        dirs, nxt, nxt2, hlast = outs
+        return dirs, nxt, nxt2, hlast.T.astype(jnp.int32)
+    dirs, nxt, hlast = outs
     return dirs, nxt, hlast.T.astype(jnp.int32)
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("match", "mismatch", "gap", "W"))
+                   static_argnames=("match", "mismatch", "gap", "W",
+                                    "nxt_k"))
 def fw_dirs_band_xla(tband: jnp.ndarray, qT: jnp.ndarray, klo: jnp.ndarray,
                      lq: jnp.ndarray, *, match: int, mismatch: int,
-                     gap: int, W: int):
+                     gap: int, W: int, nxt_k: int = 2):
     """Row-scan twin of fw_dirs_band (CPU tests / non-TPU fallback);
     bit-identical outputs by construction (same score dtype selection,
-    fills and clamps as the Pallas kernel)."""
+    fills and clamps as the Pallas kernel). ``nxt_k=4`` adds the
+    ``nxt2`` uint16 plane to the return, like the Pallas entry point."""
     B = tband.shape[0]
     Lq = qT.shape[0]
     dtype = _score_dtype(match, mismatch, gap, Lq, W)
@@ -289,9 +338,11 @@ def fw_dirs_band_xla(tband: jnp.ndarray, qT: jnp.ndarray, klo: jnp.ndarray,
     U0 = jnp.zeros((B, W), jnp.int32)
     C0 = jnp.full((B, W), LEFT, jnp.int32)
     N0 = jnp.full((B, W), LEFT, jnp.int32)
+    deep = nxt_k >= 4
+    hops0 = (N0, N0) if deep else ()
 
     def step(carry, inp):
-        P, hl, Up, Cp, Np = carry
+        P, hl, Up, Cp, Np, *hp = carry
         i, qrow = inp
         tw = jax.lax.dynamic_slice_in_dim(t32, i - 1, W, axis=1)
         jcol = i + klo[:, None] + xr
@@ -318,44 +369,65 @@ def fw_dirs_band_xla(tband: jnp.ndarray, qT: jnp.ndarray, klo: jnp.ndarray,
         d = jnp.where(h == diag, DIAG,
                       jnp.where(h == up, UP, LEFT))
         isup = d == UP
-        uup = jnp.concatenate(
-            [Up[:, 1:], jnp.zeros((B, 1), jnp.int32)], axis=1)
-        cup = jnp.concatenate(
-            [Cp[:, 1:], jnp.full((B, 1), LEFT, jnp.int32)], axis=1)
-        nup = jnp.concatenate(
-            [Np[:, 1:], jnp.full((B, 1), LEFT, jnp.int32)], axis=1)
+
+        def shift_up(A, fill):
+            return jnp.concatenate(
+                [A[:, 1:], jnp.full((B, 1), fill, jnp.int32)], axis=1)
+
+        def shift_left(A):
+            return jnp.concatenate(
+                [jnp.full((B, 1), LEFT, jnp.int32), A[:, :-1]], axis=1)
+
+        uup = shift_up(Up, 0)
+        cup = shift_up(Cp, LEFT)
+        nup = shift_up(Np, LEFT)
         U = jnp.where(isup, jnp.minimum(uup + 1, U_SAT), 0)
         C = jnp.where(isup, cup, d)
-        # Dual-column metadata — same three-shift propagation as the
-        # Pallas kernel (see _kernel): UP inherits from above, DIAG takes
-        # the previous row's same-slot (U, C), LEFT this row's slot x-1.
+        # k-step predecessor metadata — same three-shift propagation as
+        # the Pallas kernel (see _kernel): UP inherits field m from
+        # above, DIAG takes the previous row's same-slot field m-1,
+        # LEFT this row's just-computed field m-1 at slot x-1.
         ucnow = (U << 2) + C
-        nleft = jnp.concatenate(
-            [jnp.full((B, 1), LEFT, jnp.int32), ucnow[:, :-1]], axis=1)
         N = jnp.where(isup, nup,
-                      jnp.where(d == DIAG, (Up << 2) + Cp, nleft))
+                      jnp.where(d == DIAG, (Up << 2) + Cp, shift_left(ucnow)))
         packed = (d + (C << 2) + (U << 4)).astype(jnp.uint8)
         hl = jnp.where((lq == i)[:, None], h, hl)
         # ONE stacked uint8 ys (not a tuple): a scan emitting a TUPLE of
         # narrow-dtype ys miscompiles under XLA CPU jit in jax 0.9 (the
         # reverse-scan int16 variant is the verified case, see
         # racon_tpu/ops/colwalk.py) — don't gamble on the forward form.
+        # At nxt_k=4 the hop-2/3 bytes ride the same stacked u8 ys; the
+        # u16 nxt2 plane is assembled OUTSIDE the scan.
+        if deep:
+            N2p, N3p = hp
+            N2 = jnp.where(isup, shift_up(N2p, LEFT),
+                           jnp.where(d == DIAG, Np, shift_left(N)))
+            N3 = jnp.where(isup, shift_up(N3p, LEFT),
+                           jnp.where(d == DIAG, N2p, shift_left(N2)))
+            ys = jnp.stack([packed, N.astype(jnp.uint8),
+                            N2.astype(jnp.uint8), N3.astype(jnp.uint8)],
+                           axis=0)
+            return (h, hl, U, C, N, N2, N3), ys
         return (h, hl, U, C, N), jnp.stack(
             [packed, N.astype(jnp.uint8)], axis=0)
 
     ii = jnp.arange(1, Lq + 1, dtype=jnp.int32)
-    (_, hlast, _, _, _), ys = jax.lax.scan(step, (P0, hl0, U0, C0, N0),
-                                           (ii, qT.astype(jnp.int32)))
+    carry0 = (P0, hl0, U0, C0, N0) + hops0
+    carry, ys = jax.lax.scan(step, carry0, (ii, qT.astype(jnp.int32)))
+    hlast = carry[1]
+    if deep:
+        nxt2 = (ys[:, 2].astype(jnp.uint16) |
+                (ys[:, 3].astype(jnp.uint16) << 8))
+        return ys[:, 0], ys[:, 1], nxt2, hlast.astype(jnp.int32)
     return ys[:, 0], ys[:, 1], hlast.astype(jnp.int32)
 
 
-UC_BOUNDARY = (LEFT << 6) | LEFT   # row-0 / out-of-band packed (N,U,C)
+UC_BOUNDARY = uc_boundary(2)   # row-0 / out-of-band packed (N,U,C)
 
 
 def _kernel_tile(tbandT_ref, qT_ref, klo_ref, lq_ref, i0_ref, pin_ref,
-                 ucin_ref, hlin_ref, dirs_ref, nxt_ref, hlast_ref,
-                 prev_ref, ucprev_ref, *, match, mismatch, gap, W,
-                 dtype, TB, CH):
+                 ucin_ref, hlin_ref, *refs, match, mismatch, gap, W,
+                 dtype, TB, CH, nxt_k=2):
     # Tiled variant of _kernel for the ultralong overlap path: identical
     # row recurrence, but rows are numbered from a runtime tile origin
     # i0 (so ONE compiled kernel serves every tile of a lax.scan over
@@ -367,8 +439,14 @@ def _kernel_tile(tbandT_ref, qT_ref, klo_ref, lq_ref, i0_ref, pin_ref,
     # kernel, and this stack's Mosaic quirks (PROFILE.md "Platform
     # findings") make "refactor shared, hope TPU lowering is unchanged"
     # a bad trade against ~60 duplicated lines.
+    if nxt_k >= 4:
+        dirs_ref, nxt_ref, nxt2_ref, hlast_ref, prev_ref, ucprev_ref = refs
+    else:
+        dirs_ref, nxt_ref, hlast_ref, prev_ref, ucprev_ref = refs
+        nxt2_ref = None
     c = pl.program_id(1)
     NEG = _NEG16 if dtype == jnp.int16 else _NEG
+    BND = uc_boundary(nxt_k)
     xr = jax.lax.broadcasted_iota(jnp.int32, (W, TB), 0)
     klo = klo_ref[0]                       # [TB] int32 (this tile's band)
     lqv = lq_ref[0]                        # [TB] int32
@@ -413,18 +491,30 @@ def _kernel_tile(tbandT_ref, qT_ref, klo_ref, lq_ref, i0_ref, pin_ref,
         isup = d == UP
         ucp = ucprev_ref[:]
         ucup = jnp.concatenate(
-            [ucp[1:, :], jnp.full((1, TB), UC_BOUNDARY, jnp.int32)],
+            [ucp[1:, :], jnp.full((1, TB), BND, jnp.int32)],
             axis=0)
         U = jnp.where(isup, jnp.minimum(((ucup >> 2) & 0xF) + 1, U_SAT), 0)
         C = jnp.where(isup, ucup & 3, d)
         ucnow = (U << 2) + C
         nleft = jnp.concatenate(
             [jnp.full((1, TB), LEFT, jnp.int32), ucnow[:-1, :]], axis=0)
-        N = jnp.where(isup, ucup >> 6,
+        N = jnp.where(isup, (ucup >> 6) & 0x3F,
                       jnp.where(d == DIAG, ucp & 0x3F, nleft))
         dirs_ref[r] = (d + (C << 2) + (U << 4)).astype(jnp.uint8)
         nxt_ref[r] = N.astype(jnp.uint8)
-        ucprev_ref[:] = (N << 6) + ucnow
+        if nxt_k >= 4:
+            n1left = jnp.concatenate(
+                [jnp.full((1, TB), LEFT, jnp.int32), N[:-1, :]], axis=0)
+            N2 = jnp.where(isup, (ucup >> 12) & 0x3F,
+                           jnp.where(d == DIAG, (ucp >> 6) & 0x3F, n1left))
+            n2left = jnp.concatenate(
+                [jnp.full((1, TB), LEFT, jnp.int32), N2[:-1, :]], axis=0)
+            N3 = jnp.where(isup, (ucup >> 18) & 0x3F,
+                           jnp.where(d == DIAG, (ucp >> 12) & 0x3F, n2left))
+            nxt2_ref[r] = ((N3 << 8) + N2).astype(jnp.uint16)
+            ucprev_ref[:] = (N3 << 18) + (N2 << 12) + (N << 6) + ucnow
+        else:
+            ucprev_ref[:] = (N << 6) + ucnow
         prev_ref[:] = h
         hlast_ref[:] = jnp.where(lqv[None, :] == i, h, hlast_ref[:])
         return 0
@@ -434,13 +524,13 @@ def _kernel_tile(tbandT_ref, qT_ref, klo_ref, lq_ref, i0_ref, pin_ref,
 
 @functools.partial(jax.jit,
                    static_argnames=("match", "mismatch", "gap", "W",
-                                    "tb", "ch", "interpret"))
+                                    "tb", "ch", "interpret", "nxt_k"))
 def fw_dirs_band_tile(tband: jnp.ndarray, qT: jnp.ndarray,
                       klo: jnp.ndarray, lq: jnp.ndarray, i0: jnp.ndarray,
                       prev: jnp.ndarray, uc: jnp.ndarray,
                       hlast: jnp.ndarray, *, match: int, mismatch: int,
                       gap: int, W: int, tb: int = TB, ch: int = CH,
-                      interpret: bool = False):
+                      interpret: bool = False, nxt_k: int = 2):
     """One query-axis tile of the banded forward with an explicit DP
     frontier (Pallas).
 
@@ -456,26 +546,46 @@ def fw_dirs_band_tile(tband: jnp.ndarray, qT: jnp.ndarray,
              across lanes of one dispatch but ships as a lane vector so
              the kernel stays shape-stable under lax.scan).
       prev/uc/hlast: int32[B, W] carried frontier — H[i0] over the band,
-             the packed ``(N << 6) | (U << 2) | C`` metadata of row i0,
-             and the running final-row capture. For tile 0 the caller
-             passes the same init the untiled kernel builds internally
-             (j0*gap / UC_BOUNDARY / init), making a single-tile call
-             bit-identical to :func:`fw_dirs_band`.
+             the packed ``(N << 6) | (U << 2) | C`` metadata of row i0
+             (extended by the ``(N3 << 18) | (N2 << 12)`` hop fields at
+             ``nxt_k=4``), and the running final-row capture. For tile 0
+             the caller passes the same init the untiled kernel builds
+             internally (j0*gap / uc_boundary(nxt_k) / init), making a
+             single-tile call bit-identical to :func:`fw_dirs_band`.
 
     Returns (cells uint8[T, W, B], nxt uint8[T, W, B], hlast int32[B, W],
     prev int32[B, W], uc int32[B, W]) — the trailing three are the
     frontier after row i0+T, in the SAME band coordinates as the input
     (the caller shifts them when it re-centers klo for the next tile).
-    Scores are always int32: frontier magnitudes grow with the GLOBAL
-    query length, which this per-tile entry point cannot bound.
+    With ``nxt_k=4`` the ``nxt2`` uint16[T, W, B] plane is inserted
+    after ``nxt`` (6 outputs). Scores are always int32: frontier
+    magnitudes grow with the GLOBAL query length, which this per-tile
+    entry point cannot bound.
     """
     B = tband.shape[0]
     T = qT.shape[0]
     dtype = jnp.int32
     kernel = functools.partial(_kernel_tile, match=match,
                                mismatch=mismatch, gap=gap, W=W,
-                               dtype=dtype, TB=tb, CH=ch)
-    dirs, nxt, hl, pout, ucout = pl.pallas_call(
+                               dtype=dtype, TB=tb, CH=ch, nxt_k=nxt_k)
+    plane_spec = pl.BlockSpec((ch, W, tb), lambda b, c: (c, 0, b),
+                              memory_space=pltpu.VMEM)
+    # Frontier outputs persist across the sequential c steps via the
+    # constant index map — same contract the untiled kernel's hlast
+    # output already relies on.
+    front_spec = pl.BlockSpec((W, tb), lambda b, c: (0, b),
+                              memory_space=pltpu.VMEM)
+    out_specs = [plane_spec, plane_spec]
+    out_shape = [jax.ShapeDtypeStruct((T, W, B), jnp.uint8),
+                 jax.ShapeDtypeStruct((T, W, B), jnp.uint8)]
+    if nxt_k >= 4:
+        out_specs.append(plane_spec)
+        out_shape.append(jax.ShapeDtypeStruct((T, W, B), jnp.uint16))
+    out_specs += [front_spec, front_spec, front_spec]
+    out_shape += [jax.ShapeDtypeStruct((W, B), dtype),
+                  jax.ShapeDtypeStruct((W, B), dtype),
+                  jax.ShapeDtypeStruct((W, B), jnp.int32)]
+    outs = pl.pallas_call(
         kernel,
         grid=(B // tb, T // ch),
         in_specs=[
@@ -489,35 +599,12 @@ def fw_dirs_band_tile(tband: jnp.ndarray, qT: jnp.ndarray,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, tb), lambda b, c: (0, b),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((W, tb), lambda b, c: (0, b),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((W, tb), lambda b, c: (0, b),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((W, tb), lambda b, c: (0, b),
-                         memory_space=pltpu.VMEM),
+            front_spec,
+            front_spec,
+            front_spec,
         ],
-        out_specs=[
-            pl.BlockSpec((ch, W, tb), lambda b, c: (c, 0, b),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((ch, W, tb), lambda b, c: (c, 0, b),
-                         memory_space=pltpu.VMEM),
-            # Frontier outputs persist across the sequential c steps via
-            # the constant index map — same contract the untiled
-            # kernel's hlast output already relies on.
-            pl.BlockSpec((W, tb), lambda b, c: (0, b),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((W, tb), lambda b, c: (0, b),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((W, tb), lambda b, c: (0, b),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((T, W, B), jnp.uint8),
-            jax.ShapeDtypeStruct((T, W, B), jnp.uint8),
-            jax.ShapeDtypeStruct((W, B), dtype),
-            jax.ShapeDtypeStruct((W, B), dtype),
-            jax.ShapeDtypeStruct((W, B), jnp.int32),
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
         compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")),
         interpret=interpret,
@@ -525,20 +612,28 @@ def fw_dirs_band_tile(tband: jnp.ndarray, qT: jnp.ndarray,
       klo[None, :], lq[None, :], i0[None, :],
       prev.astype(dtype).T, uc.astype(jnp.int32).T,
       hlast.astype(dtype).T)
+    if nxt_k >= 4:
+        dirs, nxt, nxt2, hl, pout, ucout = outs
+        return (dirs, nxt, nxt2, hl.T.astype(jnp.int32),
+                pout.T.astype(jnp.int32), ucout.T)
+    dirs, nxt, hl, pout, ucout = outs
     return (dirs, nxt, hl.T.astype(jnp.int32), pout.T.astype(jnp.int32),
             ucout.T)
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("match", "mismatch", "gap", "W"))
+                   static_argnames=("match", "mismatch", "gap", "W",
+                                    "nxt_k"))
 def fw_dirs_band_xla_tile(tband: jnp.ndarray, qT: jnp.ndarray,
                           klo: jnp.ndarray, lq: jnp.ndarray,
                           i0: jnp.ndarray, prev: jnp.ndarray,
                           uc: jnp.ndarray, hlast: jnp.ndarray, *,
-                          match: int, mismatch: int, gap: int, W: int):
+                          match: int, mismatch: int, gap: int, W: int,
+                          nxt_k: int = 2):
     """Row-scan twin of fw_dirs_band_tile (CPU tests / non-TPU
     fallback); bit-identical outputs by construction. Cells/nxt come
     back [T, B, W] (vs the kernel's [T, W, B]), like the untiled pair.
+    ``nxt_k=4`` inserts the ``nxt2`` uint16 plane after ``nxt``.
     """
     B = tband.shape[0]
     T = qT.shape[0]
@@ -551,9 +646,11 @@ def fw_dirs_band_xla_tile(tband: jnp.ndarray, qT: jnp.ndarray,
     U0 = (uc >> 2) & 0xF
     C0 = uc & 3
     N0 = (uc >> 6) & 0x3F
+    deep = nxt_k >= 4
+    hops0 = ((uc >> 12) & 0x3F, (uc >> 18) & 0x3F) if deep else ()
 
     def step(carry, inp):
-        P, hl, Up, Cp, Np = carry
+        P, hl, Up, Cp, Np, *hp = carry
         rl, qrow = inp
         i = (i0 + rl)[:, None]             # (B, 1) global 1-based row
         tw = jax.lax.dynamic_slice_in_dim(t32, rl - 1, W, axis=1)
@@ -581,27 +678,50 @@ def fw_dirs_band_xla_tile(tband: jnp.ndarray, qT: jnp.ndarray,
         d = jnp.where(h == diag, DIAG,
                       jnp.where(h == up, UP, LEFT))
         isup = d == UP
-        uup = jnp.concatenate(
-            [Up[:, 1:], jnp.zeros((B, 1), jnp.int32)], axis=1)
-        cup = jnp.concatenate(
-            [Cp[:, 1:], jnp.full((B, 1), LEFT, jnp.int32)], axis=1)
-        nup = jnp.concatenate(
-            [Np[:, 1:], jnp.full((B, 1), LEFT, jnp.int32)], axis=1)
+
+        def shift_up(A, fill):
+            return jnp.concatenate(
+                [A[:, 1:], jnp.full((B, 1), fill, jnp.int32)], axis=1)
+
+        def shift_left(A):
+            return jnp.concatenate(
+                [jnp.full((B, 1), LEFT, jnp.int32), A[:, :-1]], axis=1)
+
+        uup = shift_up(Up, 0)
+        cup = shift_up(Cp, LEFT)
+        nup = shift_up(Np, LEFT)
         U = jnp.where(isup, jnp.minimum(uup + 1, U_SAT), 0)
         C = jnp.where(isup, cup, d)
         ucnow = (U << 2) + C
-        nleft = jnp.concatenate(
-            [jnp.full((B, 1), LEFT, jnp.int32), ucnow[:, :-1]], axis=1)
         N = jnp.where(isup, nup,
-                      jnp.where(d == DIAG, (Up << 2) + Cp, nleft))
+                      jnp.where(d == DIAG, (Up << 2) + Cp,
+                                shift_left(ucnow)))
         packed = (d + (C << 2) + (U << 4)).astype(jnp.uint8)
         hl = jnp.where((lq == i[:, 0])[:, None], h, hl)
+        if deep:
+            N2p, N3p = hp
+            N2 = jnp.where(isup, shift_up(N2p, LEFT),
+                           jnp.where(d == DIAG, Np, shift_left(N)))
+            N3 = jnp.where(isup, shift_up(N3p, LEFT),
+                           jnp.where(d == DIAG, N2p, shift_left(N2)))
+            ys = jnp.stack([packed, N.astype(jnp.uint8),
+                            N2.astype(jnp.uint8), N3.astype(jnp.uint8)],
+                           axis=0)
+            return (h, hl, U, C, N, N2, N3), ys
         return (h, hl, U, C, N), jnp.stack(
             [packed, N.astype(jnp.uint8)], axis=0)
 
     ii = jnp.arange(1, T + 1, dtype=jnp.int32)
-    (Pf, hlf, Uf, Cf, Nf), ys = jax.lax.scan(
-        step, (P0, hl0, U0, C0, N0), (ii, qT.astype(jnp.int32)))
+    carry, ys = jax.lax.scan(
+        step, (P0, hl0, U0, C0, N0) + hops0, (ii, qT.astype(jnp.int32)))
+    if deep:
+        Pf, hlf, Uf, Cf, Nf, N2f, N3f = carry
+        ucout = ((N3f << 18) + (N2f << 12) + (Nf << 6) + (Uf << 2) + Cf)
+        nxt2 = (ys[:, 2].astype(jnp.uint16) |
+                (ys[:, 3].astype(jnp.uint16) << 8))
+        return (ys[:, 0], ys[:, 1], nxt2, hlf.astype(jnp.int32),
+                Pf.astype(jnp.int32), ucout)
+    Pf, hlf, Uf, Cf, Nf = carry
     ucout = (Nf << 6) + (Uf << 2) + Cf
     return (ys[:, 0], ys[:, 1], hlf.astype(jnp.int32),
             Pf.astype(jnp.int32), ucout)

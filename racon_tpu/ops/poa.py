@@ -316,11 +316,39 @@ class PoaEngine:
                 log=self.log)
 
     def _redo_trunc(self, trunc: List[Window]) -> None:
-        if trunc:
+        """Flagged windows (anchor overflow / escape failure /
+        saturation) re-run through the on-device wide-band second pass
+        (ops/redo.py: 4x anchor growth slack, 2x band width); whatever
+        the wide pass cannot certify — the saturation class, or growth
+        past even the widened slack — takes the unbounded host path, as
+        every flagged window did before round 8 (RACON_TPU_REDO=0
+        restores that behavior wholesale)."""
+        if not trunc:
+            return
+        from racon_tpu.obs.metrics import record_redo
+        from racon_tpu.ops.redo import device_redo, redo_enabled
+        remaining = trunc
+        if redo_enabled():
             print(f"[racon_tpu::PoaEngine] {len(trunc)} window(s) "
-                  "outgrew the device anchor budget; re-polishing on "
+                  "flagged; re-polishing through the wide-band device "
+                  "pass", file=self.log)
+            resolved, remaining = device_redo(
+                trunc, match=self.match, mismatch=self.mismatch,
+                gap=self.gap,
+                ins_scale=self._round_scales(self.refine_rounds + 1),
+                rounds=self.refine_rounds + 1, mesh=self.mesh,
+                jobs_cap=self.device_batch, stats=self.stats,
+                log=self.log)
+            for w, c, cv in resolved:
+                w.apply_consensus(
+                    decode_bases(np.frombuffer(c, dtype=np.uint8)), cv,
+                    log=self.log)
+        record_redo(len(trunc) - len(remaining), len(remaining))
+        if remaining:
+            print(f"[racon_tpu::PoaEngine] {len(remaining)} window(s) "
+                  "unresolved by the wide-band pass; re-polishing on "
                   "the host path", file=self.log)
-            self._consensus_host(trunc, force_native=True)
+            self._consensus_host(remaining, force_native=True)
 
     def _degrade(self, ws: List[Window], exc) -> None:
         """Last-resort graceful degradation: a transfer/dispatch choke

@@ -52,7 +52,7 @@ import functools
 
 def _sched_core(bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf, *,
                 match, mismatch, gap, scale, scale_final, Lq, n_win, LA,
-                pallas, band_w, detect, axis_name=None):
+                pallas, band_w, nxt_k=2, detect=False, axis_name=None):
     """One detecting round (traced body, single shard's view).
 
     _round_core's alignment+merge (shared via device_poa._lane_votes /
@@ -74,7 +74,7 @@ def _sched_core(bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf, *,
     votes, esc_w = _lane_votes(
         bb, alen, begin, end, q, qw8, lq, w_read, win, match=match,
         mismatch=mismatch, gap=gap, Lq=Lq, LA=LA, pallas=pallas,
-        band_w=band_w)
+        band_w=band_w, nxt_k=nxt_k)
     acc = dm.aggregate_votes(votes, win, n_win + 1, extras={"_esc": esc_w})
     if axis_name is not None:
         acc = {k: jax.lax.psum(v, axis_name) for k, v in acc.items()}
@@ -124,14 +124,14 @@ def _sched_core(bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf, *,
 
 
 def _make_sched_fn(*, match, mismatch, gap, scale, scale_final, Lq, n_win,
-                   LA, pallas, band_w, detect, mesh):
+                   LA, pallas, band_w, detect, mesh, nxt_k=2):
     """_sched_core, or its dp-sharded shard_map under a mesh (same
     sharding contract as device_poa._make_round_fn: job axis over "dp",
     window arrays replicated, psums inside the core)."""
     core = functools.partial(
         _sched_core, match=match, mismatch=mismatch, gap=gap, scale=scale,
         scale_final=scale_final, Lq=Lq, n_win=n_win, LA=LA, pallas=pallas,
-        band_w=band_w, detect=detect,
+        band_w=band_w, nxt_k=nxt_k, detect=detect,
         axis_name=None if mesh is None else "dp")
     if mesh is None:
         return core
@@ -175,11 +175,12 @@ def sched_unpack(job_buf, win_buf, *, Lq, LA, n_win):
     __import__("jax").jit,
     static_argnames=("match", "mismatch", "gap", "scale", "scale_final",
                      "Lq", "n_win", "LA", "pallas", "band_ws", "detect",
-                     "adaptive", "mesh"))
+                     "adaptive", "mesh", "nxt_k"))
 def sched_rounds(bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf,
                  out_codes, out_cov, out_total, out_ovf, orig_ids, last, *,
                  match, mismatch, gap, scale, scale_final, Lq, n_win, LA,
-                 pallas, band_ws, detect, adaptive=False, mesh=None):
+                 pallas, band_ws, detect, adaptive=False, mesh=None,
+                 nxt_k=2):
     """Run ``len(band_ws)`` refinement rounds in one dispatch, detect on
     the last of them, and scatter frozen windows' final-scale outputs.
 
@@ -212,7 +213,8 @@ def sched_rounds(bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf,
         fn_mid = _make_sched_fn(
             match=match, mismatch=mismatch, gap=gap, scale=scale,
             scale_final=scale_final, Lq=Lq, n_win=n_win, LA=LA,
-            pallas=pallas, band_w=band_ws[0], detect=True, mesh=mesh)
+            pallas=pallas, band_w=band_ws[0], detect=True, mesh=mesh,
+            nxt_k=nxt_k)
 
         def cond(c):
             return (c[0] < len(band_ws) - 1) & ~jnp.all(c[6] | c[7])
@@ -229,7 +231,8 @@ def sched_rounds(bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf,
         fn_last = _make_sched_fn(
             match=match, mismatch=mismatch, gap=gap, scale=scale,
             scale_final=scale_final, Lq=Lq, n_win=n_win, LA=LA,
-            pallas=pallas, band_w=band_ws[-1], detect=False, mesh=mesh)
+            pallas=pallas, band_w=band_ws[-1], detect=False, mesh=mesh,
+            nxt_k=nxt_k)
         (bb, bbw, alen, begin, end, conv, ovf, ovf_f, codes_f, cov_f,
          total_f) = fn_last(bb, bbw, alen, begin, end, q, qw8, lq,
                             w_read, win, ovf)
@@ -240,7 +243,8 @@ def sched_rounds(bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf,
                 match=match, mismatch=mismatch, gap=gap, scale=scale,
                 scale_final=scale_final, Lq=Lq, n_win=n_win, LA=LA,
                 pallas=pallas, band_w=bw,
-                detect=detect and i == len(band_ws) - 1, mesh=mesh)
+                detect=detect and i == len(band_ws) - 1, mesh=mesh,
+                nxt_k=nxt_k)
             (bb, bbw, alen, begin, end, conv, ovf, ovf_f, codes_f, cov_f,
              total_f) = fn(bb, bbw, alen, begin, end, q, qw8, lq, w_read,
                            win, ovf)

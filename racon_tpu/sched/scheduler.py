@@ -117,10 +117,18 @@ class ConvergenceScheduler:
         ndp = self.mesh.shape["dp"] if self.mesh is not None else 1
         band_w = (0 if os.environ.get("RACON_TPU_NO_BAND", "")
                   not in ("", "0", "false") else plan.band_w)
+        # Same per-chunk walk-depth selection as dispatch_chunk: pick k
+        # at the round-0 (widest) band so every dispatch shares one k.
+        from racon_tpu.ops.budget import walk_k_for
+        nxt_k = walk_k_for(plan.B // ndp * plan.Lq * band_w) \
+            if band_w else 1
+        from racon_tpu.ops.colwalk import chain_len
+        obs_registry().set("walk_chain_len",
+                           chain_len(plan.LA, nxt_k if band_w else 1))
         statics = dict(match=self.match, mismatch=self.mismatch,
                        gap=self.gap, scale=self.scale,
                        scale_final=self.scale_final, Lq=plan.Lq,
-                       LA=plan.LA, mesh=self.mesh)
+                       LA=plan.LA, mesh=self.mesh, nxt_k=nxt_k)
 
         if bufs is None:
             bufs = self.put_chunk(plan)

@@ -1,17 +1,18 @@
-"""Ablation: traceback dependency-chain length, single vs dual walk.
+"""Ablation: traceback dependency-chain length vs walk depth k.
 
 The column walk's cost on TPU is its serialized per-column HBM gather
-chain (PROFILE.md round 5's top remaining compute cost). The dual-
-column walk consumes the band kernels' nxt plane to undo TWO anchor
-positions per dependent gather, halving the chain:
+chain (PROFILE.md round 5's top remaining compute cost). The k-step
+walk consumes the band kernels' packed predecessor planes to undo k
+anchor positions per dependent gather, dividing the chain:
 
-  single : LA + 2 columns -> 1 dependent gather per column
-  dual   : LA + 2 columns -> 1 dependent gather per 2 columns
+  k=1 : LA + 2 columns -> 1 dependent gather per column (reference)
+  k=2 : nxt plane       -> 1 dependent gather per 2 columns
+  k=4 : nxt + nxt2 u16  -> 1 dependent gather per 4 columns
 
-Runs the band forward (XLA twin, any backend) once per Lq, then times
-col_walk with and without the nxt plane and checks bit-identity of the
-unflagged-lane channels — the ratio isolates lever 1 of round 6 from
-kernel cost.
+Runs the band forward (XLA twin, any backend) once per (Lq, k), then
+times col_walk at each depth and checks bit-identity of the
+unflagged-lane channels against the k=1 reference — the ratio isolates
+lever 1 of round 6 (and round 8's k=4 extension) from kernel cost.
 """
 
 import os
@@ -21,6 +22,8 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
+
+KS = (1, 2, 4)
 
 
 def t(fn, *args, reps=10):
@@ -60,37 +63,49 @@ def main():
     import jax
     import jax.numpy as jnp
 
-    from racon_tpu.ops.colwalk import col_walk
+    from racon_tpu.ops.colwalk import chain_len, col_walk
     from racon_tpu.ops.pallas.band_kernel import fw_dirs_band_xla
 
     B, W = 1024, 128
     rng = np.random.default_rng(0)
     print(f"backend={jax.default_backend()}  B={B} W={W}")
-    print(f"{'Lq':>6} {'chain_s':>8} {'chain_d':>8} "
-          f"{'single_ms':>10} {'dual_ms':>8} {'speedup':>8} {'bitid':>6}")
+    hdr = f"{'Lq':>6}"
+    for k in KS:
+        hdr += f" {'chain_k%d' % k:>9} {'k%d_ms' % k:>8}"
+    hdr += f" {'k4/k1':>7} {'bitid':>6}"
+    print(hdr)
     for Lq in (128, 256, 512, 1024):
         tband, qT, klo, lq, lt = _inputs(rng, B, Lq, W)
-        dirs, nxt, _ = fw_dirs_band_xla(
-            jnp.asarray(tband), jnp.asarray(qT), klo, jnp.asarray(lq),
-            match=5, mismatch=-4, gap=-8, W=W)
+        fwd = (jnp.asarray(tband), jnp.asarray(qT), klo, jnp.asarray(lq))
+        kw = dict(match=5, mismatch=-4, gap=-8, W=W)
+        dirs, nxt, _ = fw_dirs_band_xla(*fwd, **kw)
+        _, _, nxt2, _ = fw_dirs_band_xla(*fwd, nxt_k=4, **kw)
         LA = tband.shape[1] + 16
         t_off = jnp.zeros(B, jnp.int32)
         args = (dirs, jnp.asarray(lq), jnp.asarray(lt), klo, t_off)
-        single = jax.jit(functools.partial(col_walk, LA=LA, layout="band"))
-        dual = jax.jit(functools.partial(col_walk, LA=LA, layout="band",
-                                         nxt=nxt))
-        ts_ = t(single, *args)
-        td_ = t(dual, *args)
-        s, d = single(*args), dual(*args)
-        ok = ~np.asarray(s["sat"])
-        bitid = (np.array_equal(np.asarray(s["sat"]),
-                                np.asarray(d["sat"])) and
-                 all(np.array_equal(np.asarray(s[k])[ok],
-                                    np.asarray(d[k])[ok])
-                     for k in ("ins_len", "qstart", "op_c", "qi_c")))
-        print(f"{Lq:>6} {LA + 2:>8} {(LA + 2 + 1) // 2:>8} "
-              f"{ts_ * 1e3:>10.2f} {td_ * 1e3:>8.2f} "
-              f"{ts_ / td_:>7.2f}x {'PASS' if bitid else 'FAIL':>6}")
+        planes = {1: dict(), 2: dict(nxt=nxt),
+                  4: dict(nxt=nxt, nxt2=nxt2)}
+        times, outs = {}, {}
+        for k in KS:
+            fn = jax.jit(functools.partial(col_walk, LA=LA,
+                                           layout="band", **planes[k]))
+            times[k] = t(fn, *args)
+            outs[k] = fn(*args)
+        ref = outs[1]
+        ok = ~np.asarray(ref["sat"])
+        bitid = all(
+            np.array_equal(np.asarray(ref["sat"]),
+                           np.asarray(outs[k]["sat"])) and
+            all(np.array_equal(np.asarray(ref[c])[ok],
+                               np.asarray(outs[k][c])[ok])
+                for c in ("ins_len", "qstart", "op_c", "qi_c"))
+            for k in KS[1:])
+        row = f"{Lq:>6}"
+        for k in KS:
+            row += f" {chain_len(LA, k):>9} {times[k] * 1e3:>8.2f}"
+        row += (f" {times[1] / times[4]:>6.2f}x"
+                f" {'PASS' if bitid else 'FAIL':>6}")
+        print(row)
         if not bitid:
             sys.exit(1)
 

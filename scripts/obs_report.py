@@ -191,6 +191,7 @@ def render(tr: Dict[str, object], out=sys.stdout) -> None:
     _render_pipeline(m, out)
     _render_resilience(m, by_kind, out)
     _render_dist(m, by_kind, out)
+    _render_redo(m, out)
     if m:
         keys = [k for k in sorted(m) if k != "ev"]
         print("\nmetrics:", file=out)
@@ -319,6 +320,29 @@ def _render_dist(m, by_kind, out) -> None:
         workers = ", ".join(f"{w}: {n}" for w, n in
                             sorted(by_worker.items()))
         print(f"  events by worker: {workers}", file=out)
+
+
+def _render_redo(m, out) -> None:
+    """The "Redo" section: where flagged windows were resolved (the
+    on-device wide-band pass vs the host fallback) and the walk's
+    dependent-gather chain length, from the ``redo_*`` counters and the
+    ``walk_chain_len`` gauge (docs/KERNELS.md "Wide-band device redo").
+    Runs with no flagged windows print only the chain gauge."""
+    m = m or {}
+    passes = int(m.get("redo_passes", 0) or 0)
+    chain = m.get("walk_chain_len")
+    if not passes and chain is None:
+        return
+    if passes:
+        dev = int(m.get("redo_device_windows", 0))
+        host = int(m.get("redo_host_windows", 0))
+        tail = "" if host else "  (host untouched mid-polish)"
+        print(f"\nredo: passes={passes}  device_windows={dev}  "
+              f"host_windows={host}{tail}", file=out)
+    if chain is not None:
+        lead = "" if passes else "\n"
+        print(f"{lead}walk chain: {int(chain)} dependent gather(s) "
+              "per column scan", file=out)
 
 
 def main(argv: Optional[List[str]] = None) -> int:

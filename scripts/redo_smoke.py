@@ -1,0 +1,153 @@
+"""CI smoke: flagged windows resolve through the on-device wide-band
+redo pass — zero host consensus redos, byte-identical output.
+
+The workload engineers the anchor-growth flag class: each draft contig
+drops every second base across most of its truth sequence, so the
+consensus must GROW ~260 bases past the backbone — more than the
+round-0 chunk's ``la_grow = 64`` anchor slack plus its 128-grid
+padding, which raises the sticky device overflow flag. (The deletions are scattered single bases, so no
+insertion run approaches ``U_SAT`` — this is exactly the
+redo-recoverable class, not the saturation class.) Before round 8
+those windows re-polished on the HOST (serial native POA mid-polish);
+now ``ops/redo.py`` re-runs them on device at 4x growth slack / 2x
+band and the host path never fires:
+
+1. ``RACON_TPU_REDO=0`` (the pre-round-8 behavior): run completes,
+   trace metrics show ``redo_host_windows >= 1`` — proof the workload
+   really triggers the legacy host-redo class.
+2. Default run: stdout byte-identical to (1), ``redo_device_windows
+   >= 1``, ``redo_host_windows == 0``, ``walk_chain_len`` gauge
+   published, trace schema valid, and obs_report renders its "redo:"
+   section from the footer.
+
+Subprocesses (not in-process cli.main) so each run's env gates arm
+independently and the metrics registry starts clean.
+"""
+
+import io
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np                                   # noqa: E402
+
+BASES = np.frombuffer(b"ACGT", np.uint8)
+BOOT = "import sys; from racon_tpu import cli; sys.exit(cli.main(sys.argv[1:]))"
+
+
+def _noisy(rng, truth, err=0.02):
+    out = []
+    for b in truth:
+        r = rng.random()
+        if r < err / 2:
+            continue
+        out.append(int(rng.integers(0, 4)) if r < err else int(
+            np.searchsorted(BASES, b)))
+    return bytes(BASES[np.array(out)])
+
+
+def _write_inputs(d, n_contigs=2):
+    rng = np.random.default_rng(17)
+    drafts, reads, paf = [], [], []
+    for c in range(n_contigs):
+        truth = BASES[rng.integers(0, 4, 900 + 32 * c)]
+        # Draft drops every 2nd base of truth[40:460]: ~210 scattered
+        # single-base deletions, all landing in the draft's FIRST
+        # 500-base window -> that window's consensus grows past the
+        # anchor slack (la_grow=64 plus <=127 of 128-grid padding),
+        # with no multi-base insertion run anywhere near U_SAT, while
+        # the whole-read length imbalance (~23%) stays inside the
+        # overlap error filter.
+        keep = np.ones(len(truth), bool)
+        keep[40:460:2] = False
+        draft = bytes(BASES[np.searchsorted(BASES, truth[keep])])
+        drafts.append(b">c%d\n%s\n" % (c, draft))
+        for i in range(8):
+            r = _noisy(rng, truth)
+            rid = f"r{c}_{i}"
+            reads.append(b">%s\n%s\n" % (rid.encode(), r))
+            paf.append(f"{rid}\t{len(r)}\t0\t{len(r)}\t+\tc{c}"
+                       f"\t{len(draft)}\t0\t{len(draft)}"
+                       f"\t{min(len(r), len(draft))}"
+                       f"\t{max(len(r), len(draft))}\t60")
+    with open(os.path.join(d, "draft.fasta"), "wb") as fh:
+        fh.write(b"".join(drafts))
+    with open(os.path.join(d, "reads.fasta"), "wb") as fh:
+        fh.write(b"".join(reads))
+    with open(os.path.join(d, "ovl.paf"), "w") as fh:
+        fh.write("\n".join(paf) + "\n")
+
+
+def _run(d, env=None):
+    e = dict(os.environ)
+    for k in ("RACON_TPU_REDO", "RACON_TPU_TRACE"):
+        e.pop(k, None)
+    e.update(env or {})
+    proc = subprocess.run(
+        [sys.executable, "-c", BOOT, "--backend", "jax",
+         os.path.join(d, "reads.fasta"), os.path.join(d, "ovl.paf"),
+         os.path.join(d, "draft.fasta")],
+        capture_output=True, env=e)
+    return proc.returncode, proc.stdout, proc.stderr.decode()
+
+
+def _metrics_footer(trace_path):
+    with open(trace_path) as fh:
+        for line in fh:
+            rec = json.loads(line)
+            if rec.get("ev") == "metrics":
+                return rec
+    raise AssertionError(f"no metrics footer in {trace_path}")
+
+
+def main():
+    with tempfile.TemporaryDirectory() as d:
+        _write_inputs(d)
+
+        # --- pre-round-8 behavior: the flagged class lands on the host.
+        trace0 = os.path.join(d, "host.jsonl")
+        rc, base, err = _run(d, env={"RACON_TPU_REDO": "0",
+                                     "RACON_TPU_TRACE": trace0})
+        assert rc == 0, err
+        assert base.count(b">") == 2, "expected 2 polished contigs"
+        m0 = _metrics_footer(trace0)
+        host0 = int(m0.get("redo_host_windows", 0))
+        assert host0 >= 1, (
+            f"workload no longer triggers the host-redo class: {m0}")
+
+        # --- round-8 default: same windows resolve on device.
+        trace1 = os.path.join(d, "device.jsonl")
+        rc, out, err = _run(d, env={"RACON_TPU_TRACE": trace1})
+        assert rc == 0, err
+        assert out == base, \
+            "wide-band device redo output differs from the host path"
+        m1 = _metrics_footer(trace1)
+        assert int(m1.get("redo_device_windows", 0)) >= 1, m1
+        assert int(m1.get("redo_host_windows", 0)) == 0, m1
+        assert int(m1.get("walk_chain_len", 0)) >= 1, m1
+
+        from scripts import obs_report
+        tr = obs_report.load_trace(trace1)
+        errs = obs_report.validate(tr)
+        assert not errs, "trace schema violations:\n" + "\n".join(errs)
+        buf = io.StringIO()
+        obs_report.render(tr, out=buf)
+        rendered = buf.getvalue()
+        assert "redo:" in rendered and "walk chain:" in rendered, rendered
+
+        print(f"[redo-smoke] {host0} host-redo window(s) under "
+              f"RACON_TPU_REDO=0 -> "
+              f"{int(m1['redo_device_windows'])} device / "
+              f"{int(m1['redo_host_windows'])} host with the wide-band "
+              f"pass; walk chain {int(m1['walk_chain_len'])}; output "
+              "byte-identical", flush=True)
+
+    print("[redo-smoke] PASS", flush=True)
+
+
+if __name__ == "__main__":
+    main()

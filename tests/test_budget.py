@@ -132,3 +132,78 @@ def test_tile_plan_rejects_untrackable_jobs():
     # Degenerate operands clamp to one tile instead of dividing by zero
     # (the dispatcher screens empty jobs before planning anyway).
     assert budget.tile_plan(0, 0).Lq == budget.TILE_TIERS[0][2]
+
+
+# ---------------------------------------------------------------------------
+# Walk-depth admission (round 8): the k=4 nxt2 plane costs one u16 plane
+# of elements and doubles vmem_est's metadata planes term. Every tier's
+# admission decision is pinned here — a drifting estimate would either
+# OOM VMEM on TPU or silently degrade the bench chain back to 321.
+# ---------------------------------------------------------------------------
+
+
+def test_vmem_est_nxt_k_term():
+    # The deep plane doubles the per-row metadata planes (u8 nxt +
+    # u16 nxt2 = 3 bytes padded to two u32-backed planes vs one).
+    for W, T, ch in ((128, 640, 4), (1536, 2048, 4)):
+        base = budget.vmem_est(W, T, ch)
+        assert budget.vmem_est(W, T, ch, 2) == base
+        assert budget.vmem_est(W, T, ch, 4) == base + 128 * W * 4 * ch
+
+
+def test_walk_k_env_validation(monkeypatch):
+    monkeypatch.delenv(budget.WALK_K_ENV, raising=False)
+    assert budget.walk_k_env() == 4                # round-8 default
+    for v in ("1", "2", "4"):
+        monkeypatch.setenv(budget.WALK_K_ENV, v)
+        assert budget.walk_k_env() == int(v)
+    monkeypatch.setenv(budget.WALK_K_ENV, "3")
+    try:
+        budget.walk_k_env()
+    except ValueError:
+        pass
+    else:
+        raise AssertionError("walk depth 3 must be rejected")
+
+
+def test_walk_k_for_element_boundary():
+    # The u16 plane makes the forward's largest buffer 2 bytes/cell, so
+    # k=4 admission is gated by max_dir_elems(2) exactly.
+    cap2 = budget.max_dir_elems(2)
+    assert budget.walk_k_for(cap2) == 4
+    assert budget.walk_k_for(cap2 + 1) == 2
+    # The 8 kb genome overlap geometry (1.61e9 elements) exceeds it:
+    # the untiled dispatcher degrades those buckets to the dual walk.
+    assert budget.walk_k_for(GENOME_ELEMS) == 2
+    # Bench consensus geometry admits the quad walk -> chain 161.
+    assert budget.walk_k_for(2048 * 640 * 128) == 4
+    # An explicit env override caps, never raises, the derived depth.
+    assert budget.walk_k_for(2048 * 640 * 128, env_k=2) == 2
+    assert budget.walk_k_for(2048 * 640 * 128, env_k=1) == 1
+    assert budget.walk_k_for(GENOME_ELEMS, env_k=4) == 2
+
+
+def test_tile_plan_walk_depth_per_tier():
+    # TilePlan carries its walk depth, and the bucket key includes it so
+    # lanes with different depths never share one kernel dispatch.
+    p = budget.tile_plan(8_192, 8_292)
+    assert p.nxt_k == 4                   # 64-lane tier, Lq=8192: both
+    assert p.key() == (64, 1536, 2048, 4, 4)  # gates pass (pins below)
+    assert 64 * p.Lq * p.W <= budget.max_dir_elems(2)
+    assert budget.vmem_est(p.W, p.T, p.ch, 4) <= budget.VMEM_BUDGET
+    # One tile row higher (Lq pads to 10240): element cap degrades to 2.
+    assert budget.tile_plan(9_000, 9_100).nxt_k == 2
+    assert 64 * 10_240 * 1536 > budget.max_dir_elems(2)
+    # The W=2048 tiers never admit k=4 — their deep-plane VMEM blocks
+    # overflow the 12 MiB budget at any row chunk.
+    assert budget.vmem_est(2048, 2048, 4, 4) > budget.VMEM_BUDGET
+    assert budget.vmem_est(2048, 4096, 4, 4) > budget.VMEM_BUDGET
+    assert budget.tile_plan(32_768, 33_000).nxt_k == 2
+    assert budget.tile_plan(100_000, 101_000).nxt_k == 2
+    # Every emitted plan's depth is self-consistent with both gates.
+    for lq in (9_000, 12_000, 32_768, 100_000, 114_000):
+        plan = budget.tile_plan(lq, lq + 500)
+        if plan.nxt_k >= 4:
+            assert plan.lanes * plan.Lq * plan.W <= budget.max_dir_elems(2)
+            assert budget.vmem_est(plan.W, plan.T, plan.ch, 4) \
+                <= budget.VMEM_BUDGET

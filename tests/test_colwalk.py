@@ -130,8 +130,8 @@ def test_colwalk_matches_legacy_band():
     _votes_equal(old, new)
 
 
-def _band_case(rng, B, err):
-    """Random banded jobs -> (dirs, nxt, lq, lt, klo, LA)."""
+def _band_case(rng, B, err, nxt_k=2):
+    """Random banded jobs -> (dirs, nxt, nxt2, lq, lt, klo, LA)."""
     qs, ts = _random_jobs(rng, B, err=err)
     tbuf, qT, lq, lt = _pad(qs, ts)
     W = 128
@@ -144,10 +144,16 @@ def _band_case(rng, B, err):
             j = klo_h[b] + y
             if 0 <= j < lt[b]:
                 tband[b, y] = ts[b][j]
-    dirs, nxt, _ = fw_dirs_band_xla(jnp.asarray(tband), jnp.asarray(qT),
-                                    klo, jnp.asarray(lq), match=M,
-                                    mismatch=X, gap=G, W=W)
-    return dirs, nxt, lq, lt, klo, LA
+    if nxt_k >= 4:
+        dirs, nxt, nxt2, _ = fw_dirs_band_xla(
+            jnp.asarray(tband), jnp.asarray(qT), klo, jnp.asarray(lq),
+            match=M, mismatch=X, gap=G, W=W, nxt_k=4)
+    else:
+        dirs, nxt, _ = fw_dirs_band_xla(
+            jnp.asarray(tband), jnp.asarray(qT), klo, jnp.asarray(lq),
+            match=M, mismatch=X, gap=G, W=W)
+        nxt2 = None
+    return dirs, nxt, nxt2, lq, lt, klo, LA
 
 
 @pytest.mark.parametrize("seed,err", [(21, 0.1), (22, 0.2), (23, 0.35)])
@@ -159,7 +165,7 @@ def test_dual_walk_matches_single_walk(seed, err):
     ALWAYS (flagged windows re-polish on the host in both modes, so flag
     equality is the whole bit-identity contract for them)."""
     rng = np.random.default_rng(seed)
-    dirs, nxt, lq, lt, klo, LA = _band_case(rng, 15, err)
+    dirs, nxt, _, lq, lt, klo, LA = _band_case(rng, 15, err)
     B = lq.shape[0]
     t_off = rng.integers(0, 9, B).astype(np.int32)
     single = col_walk(dirs, jnp.asarray(lq), jnp.asarray(lt), klo,
@@ -172,6 +178,49 @@ def test_dual_walk_matches_single_walk(seed, err):
     for k in ("ins_len", "qstart", "op_c", "qi_c"):
         assert np.array_equal(np.asarray(single[k])[ok],
                               np.asarray(dual[k])[ok]), k
+
+
+@pytest.mark.parametrize("seed,err", [(41, 0.1), (42, 0.2), (43, 0.35)])
+def test_quad_walk_matches_single_walk(seed, err):
+    """Property (round 8): the quad-column walk (nxt + nxt2 u16 planes,
+    FOUR positions per dependent gather) is bit-identical to the
+    single-step reference walk AND the dual walk on randomized
+    alignments, and the k=4 forward's dirs/nxt emissions are bitwise
+    the k=2 forward's — the second plane rides along without perturbing
+    anything PR 5 shipped."""
+    rng = np.random.default_rng(seed)
+    dirs4, nxt4, nxt2, lq, lt, klo, LA = _band_case(rng, 15, err,
+                                                    nxt_k=4)
+    rng = np.random.default_rng(seed)          # same jobs, k=2 forward
+    dirs2, nxt2_, _, lq2, lt2, klo2, LA2 = _band_case(rng, 15, err)
+    assert np.array_equal(np.asarray(dirs4), np.asarray(dirs2))
+    assert np.array_equal(np.asarray(nxt4), np.asarray(nxt2_))
+    B = lq.shape[0]
+    t_off = rng.integers(0, 9, B).astype(np.int32)
+    args = (dirs4, jnp.asarray(lq), jnp.asarray(lt), klo,
+            jnp.asarray(t_off))
+    single = col_walk(*args, LA=LA, layout="band")
+    dual = col_walk(*args, LA=LA, layout="band", nxt=nxt4)
+    quad = col_walk(*args, LA=LA, layout="band", nxt=nxt4, nxt2=nxt2)
+    sat = np.asarray(single["sat"])
+    assert np.array_equal(sat, np.asarray(quad["sat"]))
+    assert np.array_equal(sat, np.asarray(dual["sat"]))
+    ok = ~sat
+    for k in ("ins_len", "qstart", "op_c", "qi_c"):
+        assert np.array_equal(np.asarray(single[k])[ok],
+                              np.asarray(quad[k])[ok]), k
+        assert np.array_equal(np.asarray(dual[k])[ok],
+                              np.asarray(quad[k])[ok]), k
+
+
+def test_quad_walk_requires_nxt():
+    """nxt2 without nxt is a caller bug, not a silent fallback."""
+    rng = np.random.default_rng(44)
+    dirs, nxt, nxt2, lq, lt, klo, LA = _band_case(rng, 3, 0.1, nxt_k=4)
+    with pytest.raises(ValueError):
+        col_walk(dirs, jnp.asarray(lq), jnp.asarray(lt), klo,
+                 jnp.zeros(lq.shape[0], jnp.int32), LA=LA,
+                 layout="band", nxt2=nxt2)
 
 
 def test_packed_byte_encode_decode():
@@ -199,6 +248,73 @@ def test_packed_byte_encode_decode():
                     assert (sc & 3) == c
                     assert ((sc >> 2) & 0xF) == u
                     assert (sc >> 6) == n
+
+
+def test_deep_plane_encode_decode():
+    """Property (round 8): the quad walk's decode shifts invert the
+    kernels' 24-bit scratch packing and the u16 nxt2 assembly for EVERY
+    valid hop-field combination.
+
+    Each hop field is 6 bits of ``(up_run << 2) | consumer`` (up_run in
+    0..U_SAT, consumer in 0..2). Scratch packs
+    ``(N3 << 18) | (N2 << 12) | (N1 << 6) | (U << 2) | C`` (24 bits,
+    int32-safe); emissions split it as nxt u8 = N1, nxt2 u16 =
+    ``(N3 << 8) | N2``. The walk reads hop 2 as ``((n2v >> 2) & 0xF,
+    n2v & 3)`` and hop 3 as ``((n2v >> 10) & 0xF, (n2v >> 8) & 3)`` —
+    the & 0xF masks are load-bearing (without them hop 3's bits alias
+    into hop 2's up_run: the exact bug class this test pins)."""
+    fields = [(u << 2) | c for u in range(U_SAT + 1) for c in range(3)]
+    for f in fields:
+        assert f < 64                      # fits one 6-bit hop slot
+    for n1 in fields:
+        for n2 in fields[::5]:
+            for n3 in fields[::7]:
+                u, c = U_SAT, 2
+                sc = (n3 << 18) + (n2 << 12) + (n1 << 6) + (u << 2) + c
+                assert sc < (1 << 24)      # int32 frontier word is safe
+                assert (sc & 3) == c
+                assert ((sc >> 2) & 0xF) == u
+                assert ((sc >> 6) & 0x3F) == n1
+                assert ((sc >> 12) & 0x3F) == n2
+                assert ((sc >> 18) & 0x3F) == n3
+                nv = (sc >> 6) & 0x3F      # nxt u8 emission
+                n2v = ((sc >> 18) << 8) + ((sc >> 12) & 0x3F)
+                assert n2v < (1 << 16)     # fits the u16 nxt2 plane
+                # Walk-side hop decode (colwalk.quad_substep).
+                assert (nv >> 2) == (n1 >> 2) and (nv & 3) == (n1 & 3)
+                assert ((n2v >> 2) & 0xF) == (n2 >> 2)
+                assert (n2v & 3) == (n2 & 3)
+                assert ((n2v >> 10) & 0xF) == (n3 >> 2)
+                assert ((n2v >> 8) & 3) == (n3 & 3)
+
+
+def test_chain_len_pins():
+    """chain_len is the acceptance-criterion quantity: at the bench
+    anchor padding LA=640 the quad walk's dependent-gather chain is 161
+    (<= the issue's ceiling), half the dual walk's 321 and a quarter of
+    the single walk's 642."""
+    from racon_tpu.ops.colwalk import chain_len
+    assert chain_len(640, 1) == 642
+    assert chain_len(640, 2) == 321
+    assert chain_len(640, 4) == 161
+    assert chain_len(0, 4) == 1
+    with pytest.raises(ValueError):
+        chain_len(640, 3)
+
+
+def test_uc_boundary_pins():
+    """Every hop field of the boundary fill decodes as (up_run 0,
+    consumer LEFT) at both plane depths, and the k=2 value is the
+    PR 5 constant (frozen: old checkpointed dirs remain walkable)."""
+    from racon_tpu.ops.pallas.band_kernel import (LEFT, UC_BOUNDARY,
+                                                  uc_boundary)
+    assert uc_boundary(2) == UC_BOUNDARY == (LEFT << 6) | LEFT
+    b4 = uc_boundary(4)
+    assert b4 == (LEFT << 18) | (LEFT << 12) | (LEFT << 6) | LEFT
+    assert (b4 & 3) == LEFT and ((b4 >> 2) & 0xF) == 0
+    for shift in (6, 12, 18):
+        f = (b4 >> shift) & 0x3F
+        assert (f & 3) == LEFT and (f >> 2) == 0
 
 
 def test_packed_byte_slice_matches_dynamic_slice():

@@ -106,6 +106,71 @@ def test_tiled_band_kernel_interpret_matches_xla_twin(scoring):
         hl, prev, uc = outs_x[2], outs_x[3], outs_x[4]
 
 
+@pytest.mark.parametrize("scoring", [(M, X, G), (0, -1, -1)])
+def test_band_kernel_interpret_matches_xla_twin_k4(scoring):
+    """Round 8: fw_dirs_band(nxt_k=4, interpret=True) ==
+    fw_dirs_band_xla(nxt_k=4) on all FOUR outputs — dirs, nxt (hop-1
+    plane), nxt2 (u16 hop-2/3 plane), hlast — and the dirs/nxt pair is
+    bitwise the k=2 kernel's (the deep plane is pure addition)."""
+    m, x, g = scoring
+    rng = np.random.default_rng(7)
+    tband, qT, klo, lq = _band_inputs(rng)
+    W = 128
+    args = (jnp.asarray(tband), jnp.asarray(qT), klo, jnp.asarray(lq))
+    kw = dict(match=m, mismatch=x, gap=g, W=W)
+    di, ni, n2i, hi = fw_dirs_band(*args, nxt_k=4, interpret=True, **kw)
+    dx, nx, n2x, hx = fw_dirs_band_xla(*args, nxt_k=4, **kw)
+    d2, n2, _ = fw_dirs_band_xla(*args, **kw)
+    assert np.asarray(n2i).dtype == np.uint16
+    assert np.array_equal(np.transpose(np.asarray(di), (0, 2, 1)),
+                          np.asarray(dx))
+    assert np.array_equal(np.transpose(np.asarray(ni), (0, 2, 1)),
+                          np.asarray(nx))
+    assert np.array_equal(np.transpose(np.asarray(n2i), (0, 2, 1)),
+                          np.asarray(n2x))
+    assert np.array_equal(np.asarray(hi), np.asarray(hx))
+    assert np.array_equal(np.asarray(dx), np.asarray(d2))
+    assert np.array_equal(np.asarray(nx), np.asarray(n2))
+
+
+def test_tiled_band_kernel_interpret_matches_xla_twin_k4():
+    """Round 8: the tiled kernels agree at nxt_k=4 on all SIX outputs
+    (dirs, nxt, nxt2, hlast, score frontier, 24-bit packed frontier)
+    across a cold and a warm tile — the geometry the wide-band device
+    redo re-dispatches flagged windows through."""
+    rng = np.random.default_rng(13)
+    B, Lq, W, T = 8, 64, 128, 32
+    tband, qT, klo, lq = _band_inputs(rng, B=B, Lq=Lq, W=W)
+    klo_h = np.asarray(klo)
+    NEG = -(2 ** 30)
+    j0 = klo_h[:, None] + np.arange(W)[None, :]
+    prev = jnp.asarray(np.where(j0 >= 0, j0 * G, NEG).astype(np.int32))
+    from racon_tpu.ops.pallas.band_kernel import uc_boundary
+    uc = jnp.asarray(np.full((B, W), uc_boundary(4), np.int32))
+    hl = prev
+    for tile in range(2):
+        i0 = jnp.full((B,), tile * T, jnp.int32)
+        tb_t = jnp.asarray(tband[:, tile * T:tile * T + W + T])
+        q_t = jnp.asarray(qT[tile * T:(tile + 1) * T])
+        outs_i = fw_dirs_band_tile(tb_t, q_t, klo, jnp.asarray(lq), i0,
+                                   prev, uc, hl, match=M, mismatch=X,
+                                   gap=G, W=W, tb=B, ch=4, nxt_k=4,
+                                   interpret=True)
+        outs_x = fw_dirs_band_xla_tile(tb_t, q_t, klo, jnp.asarray(lq),
+                                       i0, prev, uc, hl, match=M,
+                                       mismatch=X, gap=G, W=W, nxt_k=4)
+        di, ni, n2i, hi, pi, ui = [np.asarray(a) for a in outs_i]
+        dx, nx, n2x, hx, px, ux = [np.asarray(a) for a in outs_x]
+        assert n2i.dtype == np.uint16 and n2x.dtype == np.uint16
+        assert np.array_equal(np.transpose(di, (0, 2, 1)), dx), tile
+        assert np.array_equal(np.transpose(ni, (0, 2, 1)), nx), tile
+        assert np.array_equal(np.transpose(n2i, (0, 2, 1)), n2x), tile
+        assert np.array_equal(hi, hx), tile
+        assert np.array_equal(pi, px), tile
+        assert np.array_equal(ui, ux), tile
+        hl, prev, uc = outs_x[3], outs_x[4], outs_x[5]
+
+
 def test_flat_kernel_interpret_matches_xla():
     """fw_dirs_pallas(interpret=True) == flat.fw_dirs_xla bit-for-bit
     (same [Lq, B, Lt] layout, packed byte included)."""
