@@ -255,12 +255,40 @@ def main():
     adaptive_extras = {
         k: v for k, v in obs_metrics.registry().snapshot().items()
         if k.startswith("adaptive_")}
+    # RACON_TPU_BENCH_DP=<path>: fold in the dp-scaling artifact from
+    # scripts/dp_scaling_bench.py (dp_workers, dp_windows_per_sec_<N>,
+    # dp_scaling_efficiency). Loud-failure contract: pointing at a
+    # missing/invalid artifact, or one with no dp_* keys, aborts the
+    # bench rather than silently publishing a record without the curve
+    # the caller asked for.
+    dp_extras = {}
+    dp_path = os.environ.get("RACON_TPU_BENCH_DP", "")
+    if dp_path:
+        with open(dp_path, "r", encoding="utf-8") as fh:
+            dp_extras = json.load(fh)
+        assert isinstance(dp_extras, dict) and any(
+            k.startswith("dp_") for k in dp_extras), \
+            f"RACON_TPU_BENCH_DP artifact {dp_path!r} has no dp_* " \
+            "keys — re-run scripts/dp_scaling_bench.py --out"
+        dp_extras = {k: v for k, v in dp_extras.items()
+                     if k.startswith("dp_")}
     extras = {**sched_extras, **e2e_transfers, **pipe_extras,
               **probe_extras, **adaptive_extras,
               **cache_extras(), **obs_metrics.resilience_extras(),
               **obs_metrics.ovl_extras(), **obs_metrics.dist_extras(),
-              **obs_metrics.redo_extras()}
+              **obs_metrics.redo_extras(), **dp_extras}
     out = {
+        # metric_version 10: same primary value as versions 2-9 (the
+        # bench's own compute path is untouched this round). New in 10:
+        # the measured dp-scaling curve rides along when
+        # RACON_TPU_BENCH_DP points at a scripts/dp_scaling_bench.py
+        # artifact — dp_workers (the counts run), dp_windows_per_sec_<N>
+        # (fleet throughput at N ledger workers, merge byte-identity
+        # gated against serial at every N), and dp_scaling_efficiency
+        # (rate_N / (N * rate_1)). Absent when no artifact is supplied;
+        # a supplied-but-invalid artifact fails the bench loudly. This
+        # closes ROADMAP item 2's "measured dp-scaling curve as a
+        # first-class bench metric".
         # metric_version 9: same primary value as versions 2-8 (the
         # chunk program changed again this round — quad-column packed
         # walk over the new u16 nxt2 plane, bit-identity-gated — so
@@ -321,7 +349,7 @@ def main():
         # fixed_engine_windows_per_sec. Bump this whenever the primary
         # value's definition changes, so round-over-round comparisons
         # can't silently mix metrics.
-        "metric_version": 9,
+        "metric_version": 10,
         "metric": f"POA windows/sec/chip, compute-only (direct-timed warm "
                   f"production chunk, convergence-scheduled refinement "
                   f"rounds — racon_tpu/sched/, telemetry in sched_* "

@@ -62,4 +62,7 @@ python scripts/preemption_smoke.py
 echo "[ci] redo smoke (flagged windows resolve on device, zero host redos, byte-diff)"
 python scripts/redo_smoke.py
 
+echo "[ci] fleet obs smoke (2-worker fleet, 1 eviction, aggregate + OpenMetrics gate)"
+python scripts/fleet_obs_smoke.py
+
 echo "[ci] OK"

@@ -154,6 +154,22 @@ def main(argv: Optional[List[str]] = None) -> int:
     from racon_tpu.utils.jaxcache import enable_compile_cache
     enable_compile_cache()
 
+    import os as _os
+    metrics_port = _os.environ.get("RACON_TPU_METRICS_PORT", "")
+    if metrics_port:
+        # Live OpenMetrics pull endpoint (daemon thread, dies with the
+        # process): serves this worker's registry; fleet-wide scrapes
+        # aggregate the ledger dir via scripts/obs_export.py instead.
+        from racon_tpu.obs.export import render_registry, serve_metrics
+        from racon_tpu.obs.metrics import registry as _reg
+        try:
+            serve_metrics(int(metrics_port),
+                          lambda: render_registry(_reg().snapshot()))
+        except (ValueError, OSError) as exc:
+            print(f"[racon_tpu::] error: cannot serve metrics on port "
+                  f"{metrics_port!r}: {exc}", file=sys.stderr)
+            return 1
+
     from racon_tpu.models.overlap import PolisherError
     from racon_tpu.io.parsers import ParseError
     from racon_tpu.models.polisher import PolisherType, create_polisher
@@ -259,9 +275,21 @@ def main(argv: Optional[List[str]] = None) -> int:
         for s in (signal.SIGINT, signal.SIGTERM):
             old_handlers[s] = signal.signal(s, _on_signal)
 
+    from racon_tpu.obs import fleet
     from racon_tpu.obs.metrics import record_ckpt
     from racon_tpu.obs.metrics import registry as obs_registry
     rc = 0
+
+    obs_dir = _os.environ.get(fleet.ENV_OBS_DIR, "")
+    if obs_dir and not args.ledger_dir:
+        # Serial runs join the fleet observability plane on request:
+        # the same metric shard a ledger worker writes (workers install
+        # their own writer under <ledger-dir>/obs at join time).
+        from racon_tpu.resilience.checkpoint import run_fingerprint
+        fp = run_fingerprint(ckpt_config, args.paths[:3])
+        wid = args.worker_id or f"serial-{_os.getpid()}"
+        fleet.install_writer(obs_dir, wid, fp)
+        tracer.set_context(worker_id=wid, run_fp=fp)
 
     def make_polisher():
         return create_polisher(
@@ -361,6 +389,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         else:
             print(f"[racon_tpu::] interrupted (signal {exc.signum})",
                   file=sys.stderr)
+        # The eviction contract: a SIGTERM'd worker leaves a *final*
+        # metric snapshot for the fleet aggregator before dying.
+        fleet.flush_final()
         tracer.finish(metrics=obs_registry().snapshot())
         return 128 + exc.signum
     finally:
@@ -377,6 +408,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         reg.set(k, v)
     for k, v in pipeline_extras(reg).items():
         reg.set(k, v)
+    fleet.flush_final()
     tracer.finish(metrics=reg.snapshot())
     return rc
 

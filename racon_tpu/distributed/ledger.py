@@ -366,6 +366,8 @@ class WorkLedger:
             lease, sort_keys=True) + "\n").encode())
         claim.deadline = lease["deadline"]
         record_dist("lease_renewals", claim.shard, claim.worker)
+        self._event({"ev": "renew", "name": claim.name,
+                     "worker": claim.worker, "epoch": claim.epoch})
 
     def complete(self, claim: Claim, **info) -> None:
         """Publish the done marker, fenced by a final verify so a stale

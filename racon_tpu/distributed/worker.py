@@ -30,7 +30,9 @@ import time
 from typing import Callable, Optional
 
 from racon_tpu.distributed.ledger import Claim, LeaseLost, WorkLedger
+from racon_tpu.obs import fleet
 from racon_tpu.obs.metrics import record_dist, set_dist
+from racon_tpu.obs.trace import get_tracer
 from racon_tpu.resilience import checkpoint as ckpt
 from racon_tpu.resilience.faults import maybe_fault
 
@@ -87,6 +89,11 @@ def _polish_shard(ledger: WorkLedger, claim: Claim,
             for tid, rec in polisher.polish_records(drop_unpolished):
                 maybe_fault("dist/contig")
                 ledger.renew(claim)
+                # Per-contig cadence: cheap (interval-gated) and tied
+                # to the same heartbeat the lease renewal proves, so a
+                # live worker's metric shard is never staler than its
+                # lease.
+                fleet.maybe_flush()
                 if rec is not None:
                     store.commit(tid, rec.name.encode(), rec.data)
                 else:
@@ -170,6 +177,14 @@ def run_worker(*, ledger_dir: str, fingerprint: str,
     set_dist("workers", int(workers))
     set_dist("shards", ledger.n_shards)
     set_dist("n_targets", ledger.n_targets)
+    # Fleet observability plane (racon_tpu/obs/fleet.py): publish this
+    # worker's metric shard at join time, tag every span with the
+    # worker identity, and keep the shard fresh per contig. The CLI's
+    # teardown paths call fleet.flush_final() so SIGTERM evictions
+    # leave a final snapshot.
+    fleet.install_writer(os.path.join(ledger_dir, fleet.OBS_SUBDIR),
+                         worker, fingerprint)
+    get_tracer().set_context(worker_id=worker, run_fp=fingerprint)
     poll = _poll_interval(ledger.lease_s)
     print(f"[racon_tpu::dist] worker {worker}: joined ledger "
           f"{ledger_dir} ({ledger.n_targets} target(s) in "
@@ -184,6 +199,7 @@ def run_worker(*, ledger_dir: str, fingerprint: str,
             time.sleep(poll)
             continue
         maybe_fault("dist/shard")
+        get_tracer().set_context(shard=claim.shard)
         t0 = time.perf_counter()
         try:
             n = _polish_shard(ledger, claim, make_polisher,
@@ -197,6 +213,9 @@ def run_worker(*, ledger_dir: str, fingerprint: str,
                   f"shard {claim.shard} — lease stolen while working",
                   file=log)
             continue
+        finally:
+            get_tracer().set_context(shard=None)
+            fleet.maybe_flush()
         record_dist("shards_completed", claim.shard, worker)
         if claim.stolen:
             record_dist("recovery_wall_s", claim.shard, worker,

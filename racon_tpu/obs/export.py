@@ -1,0 +1,303 @@
+"""OpenMetrics/Prometheus text exporter for the metrics plane.
+
+Renders either a single-process registry snapshot or a fleet model
+(obs/fleet.py::aggregate) as OpenMetrics text — the format Prometheus
+scrapes and ``promtool`` parses:
+
+- every metric is ``racon_tpu_<key>`` (keys sanitized to the metric
+  charset), preceded by stable ``# HELP`` / ``# TYPE`` lines;
+- merge kind decides the type: ``sum`` keys are counters (sample name
+  gets the mandatory ``_total`` suffix), ``max``/``last`` keys are
+  gauges;
+- fleet renders additionally emit per-worker series
+  (``racon_tpu_worker_*{worker="..."}``) and per-shard steal counts;
+- output is **byte-stable**: keys sorted, numbers formatted through one
+  deterministic path, no timestamps — two renders of the same model are
+  identical, which tests and the smoke gate on;
+- the text ends with the ``# EOF`` terminator OpenMetrics requires.
+
+Non-numeric registry values (the sched histogram dict, fraction lists)
+have no OpenMetrics representation and are skipped — they stay
+available through bench extras and the fleet JSON model.
+
+Entry points: :func:`render_registry`, :func:`render_fleet`,
+:func:`validate_openmetrics` (the smoke/test gate), and
+:func:`serve_metrics` — a stdlib ThreadingHTTPServer pull endpoint
+the CLI starts when ``RACON_TPU_METRICS_PORT`` is set.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from racon_tpu.obs.metrics import MERGE_SUM, merge_kind
+
+PREFIX = "racon_tpu_"
+CONTENT_TYPE = ("application/openmetrics-text; version=1.0.0; "
+                "charset=utf-8")
+ENV_METRICS_PORT = "RACON_TPU_METRICS_PORT"
+
+
+def _sanitize(key: str) -> str:
+    """Map a registry key into the OpenMetrics name charset
+    ``[a-zA-Z0-9_]`` (leading digits get an underscore)."""
+    out = "".join(ch if ch.isalnum() or ch == "_" else "_"
+                  for ch in key)
+    if out and out[0].isdigit():
+        out = "_" + out
+    return out or "unnamed"
+
+
+def _fmt(value) -> str:
+    """One deterministic number path — byte-stability depends on it."""
+    if isinstance(value, bool):
+        return "1" if value else "0"
+    if isinstance(value, int):
+        return str(value)
+    f = float(value)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _escape_label(value: str) -> str:
+    return (str(value).replace("\\", "\\\\").replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
+def _labels(pairs: List[Tuple[str, str]]) -> str:
+    if not pairs:
+        return ""
+    body = ",".join(f'{k}="{_escape_label(v)}"' for k, v in pairs)
+    return "{" + body + "}"
+
+
+def _numeric(value) -> bool:
+    # bool is an int subclass; _fmt renders it 1/0.
+    return isinstance(value, (int, float))
+
+
+class _Family:
+    """One metric family: TYPE/HELP header + sorted samples."""
+
+    __slots__ = ("name", "mtype", "help", "samples")
+
+    def __init__(self, name: str, mtype: str, help_text: str):
+        self.name = name
+        self.mtype = mtype
+        self.help = help_text
+        self.samples: List[Tuple[str, str]] = []
+
+    def add(self, labels: List[Tuple[str, str]], value) -> None:
+        suffix = "_total" if self.mtype == "counter" else ""
+        self.samples.append(
+            (f"{self.name}{suffix}{_labels(labels)}", _fmt(value)))
+
+    def render(self, out: List[str]) -> None:
+        out.append(f"# HELP {self.name} {self.help}")
+        out.append(f"# TYPE {self.name} {self.mtype}")
+        for sample, value in sorted(self.samples):
+            out.append(f"{sample} {value}")
+
+
+def _family_for_key(key: str) -> _Family:
+    kind = merge_kind(key)
+    name = PREFIX + _sanitize(key)
+    if kind == MERGE_SUM and name.endswith("_total"):
+        # The sample suffix is appended by _Family.add; a key that
+        # already says _total (poa_windows_total) must not double it.
+        name = name[:-len("_total")]
+    mtype = "counter" if kind == MERGE_SUM else "gauge"
+    return _Family(name, mtype,
+                   f"racon_tpu metric {key} (merge={kind})")
+
+
+def _render(families: List[_Family]) -> str:
+    families = sorted(families, key=lambda f: f.name)
+    out: List[str] = []
+    for fam in families:
+        fam.render(out)
+    out.append("# EOF")
+    return "\n".join(out) + "\n"
+
+
+def render_registry(snapshot: Dict,
+                    labels: Optional[List[Tuple[str, str]]] = None
+                    ) -> str:
+    """Render one registry snapshot (MetricsRegistry.snapshot()) as
+    OpenMetrics text. ``labels`` are attached to every sample (the pull
+    endpoint tags ``worker``)."""
+    labels = labels or []
+    fams: Dict[str, _Family] = {}
+    for key in sorted(snapshot):
+        value = snapshot[key]
+        if not _numeric(value):
+            continue
+        fam = _family_for_key(key)
+        if fam.name in fams:
+            fam = fams[fam.name]
+        else:
+            fams[fam.name] = fam
+        fam.add(labels, value)
+    return _render(list(fams.values()))
+
+
+def render_fleet(model: Dict) -> str:
+    """Render a fleet model (obs/fleet.py::aggregate) as OpenMetrics:
+    fleet-wide merged metrics unlabeled, per-worker rate/wall/final
+    series labeled ``worker``, per-shard steal counts labeled
+    ``shard``."""
+    fams: Dict[str, _Family] = {}
+
+    def fam(key_or_fam) -> _Family:
+        f = key_or_fam if isinstance(key_or_fam, _Family) \
+            else _family_for_key(key_or_fam)
+        return fams.setdefault(f.name, f)
+
+    for key in sorted(model.get("fleet", {})):
+        value = model["fleet"][key]
+        if _numeric(value):
+            fam(key).add([], value)
+
+    n = _Family(PREFIX + "fleet_workers", "gauge",
+                "racon_tpu fleet: worker shard count")
+    fam(n).add([], model.get("n_workers", 0))
+    s = _Family(PREFIX + "fleet_steals", "counter",
+                "racon_tpu fleet: lease steals in events.jsonl")
+    fam(s).add([], model.get("steals", 0))
+
+    per_worker = (
+        ("windows_per_sec", "gauge",
+         "racon_tpu worker: polished windows per wall second"),
+        ("wall_s", "gauge", "racon_tpu worker: wall seconds at last "
+                            "snapshot"),
+        ("final", "gauge", "racon_tpu worker: 1 when the last snapshot "
+                           "was a final (exit/SIGTERM) flush"),
+    )
+    for field, mtype, help_text in per_worker:
+        f = fam(_Family(PREFIX + "worker_" + field, mtype, help_text))
+        for wid in sorted(model.get("workers", {})):
+            f.add([("worker", wid)],
+                  model["workers"][wid].get(field, 0))
+
+    timeline = model.get("timeline", {})
+    if timeline:
+        f = fam(_Family(PREFIX + "shard_steals", "counter",
+                        "racon_tpu fleet: steals per ledger shard"))
+        for name in sorted(timeline):
+            f.add([("shard", name)],
+                  sum(1 for e in timeline[name] if e["ev"] == "steal"))
+    return _render(list(fams.values()))
+
+
+# ------------------------------------------------------------ validation
+
+def validate_openmetrics(text: str) -> List[str]:
+    """Structural OpenMetrics check (the smoke/test gate — promtool is
+    not in the image). Verifies: single trailing ``# EOF``; every
+    sample parses as ``name[{labels}] value`` with a finite number;
+    every sample's family has TYPE and HELP lines *before* it; counter
+    samples end in ``_total``; families are not interleaved. Returns
+    a list of problems (empty = valid)."""
+    errors: List[str] = []
+    lines = text.split("\n")
+    if not text.endswith("\n"):
+        errors.append("missing trailing newline")
+    body = [ln for ln in lines if ln != ""]
+    if not body or body[-1] != "# EOF":
+        errors.append("missing '# EOF' terminator")
+    if text.count("# EOF") != 1:
+        errors.append("multiple '# EOF' terminators")
+    types: Dict[str, str] = {}
+    helps: Dict[str, str] = {}
+    seen_families: List[str] = []
+    for i, ln in enumerate(body):
+        if ln == "# EOF":
+            if i != len(body) - 1:
+                errors.append("content after '# EOF'")
+            break
+        if ln.startswith("# TYPE ") or ln.startswith("# HELP "):
+            parts = ln.split(" ", 3)
+            if len(parts) < 4:
+                errors.append(f"malformed meta line: {ln!r}")
+                continue
+            _, kw, fname, rest = parts
+            table = types if kw == "TYPE" else helps
+            if fname in table:
+                errors.append(f"duplicate # {kw} for {fname}")
+            table[fname] = rest
+            if kw == "TYPE":
+                if rest not in ("counter", "gauge", "histogram",
+                                "summary", "info", "unknown"):
+                    errors.append(f"bad type {rest!r} for {fname}")
+                if seen_families and seen_families[-1] != fname:
+                    seen_families.append(fname)
+                elif not seen_families:
+                    seen_families.append(fname)
+            continue
+        if ln.startswith("#"):
+            errors.append(f"unexpected comment line: {ln!r}")
+            continue
+        # Sample: name[{labels}] value
+        head, _, value = ln.rpartition(" ")
+        if not head:
+            errors.append(f"malformed sample line: {ln!r}")
+            continue
+        name = head.split("{", 1)[0]
+        if "{" in head and not head.endswith("}"):
+            errors.append(f"malformed labels in: {ln!r}")
+        fam = name
+        if fam not in types and fam.endswith("_total"):
+            fam = fam[:-len("_total")]
+        if fam not in types:
+            errors.append(f"sample {name!r} has no # TYPE line")
+            continue
+        if fam not in helps:
+            errors.append(f"sample {name!r} has no # HELP line")
+        if types[fam] == "counter" and not name.endswith("_total"):
+            errors.append(
+                f"counter sample {name!r} lacks '_total' suffix")
+        try:
+            float(value)
+        except ValueError:
+            errors.append(f"non-numeric value {value!r} in: {ln!r}")
+        if seen_families and seen_families[-1] != fam and \
+                fam in seen_families:
+            errors.append(f"family {fam!r} is interleaved")
+    return errors
+
+
+# ---------------------------------------------------------- pull endpoint
+
+def serve_metrics(port: int, render: Callable[[], str],
+                  host: str = "127.0.0.1"):
+    """Start a daemon-thread OpenMetrics pull endpoint on ``host:port``
+    serving ``render()`` at every path. Returns the server (its
+    ``.server_address`` carries the bound port — pass ``port=0`` for an
+    ephemeral one). Stdlib-only by design; errors in ``render`` become
+    a 500 so a scrape failure never kills the polisher."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 (stdlib naming)
+            try:
+                body = render().encode()
+                code = 200
+            except Exception as exc:  # scrape must not crash the run
+                body = f"render error: {exc}\n".encode()
+                code = 500
+            self.send_response(code)
+            self.send_header("Content-Type", CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):  # silence per-request stderr
+            pass
+
+    server = ThreadingHTTPServer((host, int(port)), Handler)
+    thread = threading.Thread(target=server.serve_forever,
+                              name="racon-tpu-metrics", daemon=True)
+    thread.start()
+    return server

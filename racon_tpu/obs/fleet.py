@@ -1,0 +1,360 @@
+"""Fleet observability plane: worker metric shards + aggregation.
+
+PR 7 made execution multi-process (``--ledger-dir`` fleets of
+preemptible workers), which left each worker's metrics registry and
+trace to die with its process — steal/eviction behavior was only
+reconstructable by hand from ``events.jsonl``. This module is the
+missing read side:
+
+- :class:`WorkerMetricsWriter` — every worker (and the serial CLI when
+  ``RACON_TPU_OBS_DIR`` is set) periodically snapshots its registry to
+  ``obs/worker_<id>.metrics.jsonl``, an append-ordered history of
+  snapshots rewritten atomically per flush (tmp + fsync + rename, the
+  atomicio discipline), so readers never see a torn shard no matter
+  when the worker dies. SIGTERM routes through the CLI's teardown into
+  :func:`flush_final`, so an *evicted* worker leaves a final snapshot;
+  a hard ``kill`` leaves the last periodic one. The ``obs/snapshot``
+  fault site drills the one hazard atomic publication removes: a
+  ``torn`` rule makes the flush write a truncated file *directly* to
+  the final path and hard-exit, and the aggregator must still recover
+  every complete record before the tear (load_jsonl_prefix).
+
+- :func:`aggregate` — merges all worker shards plus the ledger's
+  ``events.jsonl`` into one fleet model: per-worker last snapshot,
+  windows/s and phase seconds; fleet-wide counters folded with the
+  explicit per-metric merge kind (obs/metrics.py::merge_kind — sum for
+  counters, max for peaks, last for gauges); a per-shard lease timeline
+  (claim/renew/steal/complete, renew runs compressed). Shards written
+  by different run fingerprints refuse to merge (:class:`FleetObsError`)
+  — same discipline as the ledger itself.
+
+The model feeds scripts/obs_report.py (``fleet:`` section),
+obs/export.py (OpenMetrics render + pull endpoint), and
+scripts/dp_scaling_bench.py. Layout and merge semantics are documented
+in docs/OBSERVABILITY.md.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from racon_tpu.obs.metrics import MetricsRegistry, merge_values
+from racon_tpu.obs.metrics import registry as _default_registry
+from racon_tpu.resilience.faults import hard_exit, maybe_torn
+from racon_tpu.utils.atomicio import (atomic_write_bytes, fsync_dir,
+                                      load_jsonl_prefix)
+
+SNAPSHOT_SCHEMA = 1
+OBS_SUBDIR = "obs"
+SHARD_SUFFIX = ".metrics.jsonl"
+
+#: Serial CLI opt-in: point at a directory to get the same metric shard
+#: a fleet worker writes (the aggregator treats a one-shard directory
+#: as a one-worker fleet).
+ENV_OBS_DIR = "RACON_TPU_OBS_DIR"
+#: Seconds between periodic flushes (default 5). ``0`` flushes on every
+#: :func:`maybe_flush` call — smokes and tests use it to make snapshot
+#: cadence deterministic.
+ENV_FLUSH_S = "RACON_TPU_OBS_FLUSH_S"
+DEFAULT_FLUSH_S = 5.0
+
+
+class FleetObsError(ValueError):
+    """Unusable fleet observability state: no worker shards where some
+    were promised, or shards stamped by different run fingerprints
+    (merging metrics across runs would silently fabricate a fleet that
+    never existed)."""
+
+
+def _slug(worker_id: str) -> str:
+    """Filesystem-safe shard name component for a worker id."""
+    out = "".join(ch if ch.isalnum() or ch in "._-" else "_"
+                  for ch in str(worker_id))
+    return out[:80] or "worker"
+
+
+def shard_path(directory: str, worker_id: str) -> str:
+    return os.path.join(directory, f"worker_{_slug(worker_id)}"
+                        f"{SHARD_SUFFIX}")
+
+
+def flush_interval() -> float:
+    env = os.environ.get(ENV_FLUSH_S, "")
+    if env:
+        try:
+            return max(0.0, float(env))
+        except ValueError:
+            pass
+    return DEFAULT_FLUSH_S
+
+
+class WorkerMetricsWriter:
+    """Periodic, atomically-published registry snapshots for one worker.
+
+    The shard file is a JSONL *history*: one record per flush, ``seq``
+    strictly increasing, each record carrying the full registry
+    snapshot at that moment plus identity (``worker_id``/``run_fp``)
+    and wall clock. Each flush rewrites the whole file through
+    atomic_write_bytes, so the published file is always a complete
+    history — the aggregator just takes the last record. History size
+    is bounded: snapshots are tiny (flat dicts) and flush cadence is
+    seconds, so even hour-long runs stay in the kilobytes.
+    """
+
+    def __init__(self, directory: str, worker_id: str, run_fp: str,
+                 reg: Optional[MetricsRegistry] = None,
+                 interval_s: Optional[float] = None):
+        os.makedirs(directory, exist_ok=True)
+        fsync_dir(os.path.dirname(os.path.abspath(directory)))
+        self.directory = directory
+        self.worker_id = str(worker_id)
+        self.run_fp = str(run_fp)
+        self.path = shard_path(directory, worker_id)
+        self.interval_s = (flush_interval() if interval_s is None
+                           else max(0.0, float(interval_s)))
+        self._reg = reg if reg is not None else _default_registry()
+        self._lock = threading.Lock()
+        self._records: List[Dict] = []
+        self._t0 = time.perf_counter()
+        self._last_flush = -1.0
+        self._final = False
+
+    def maybe_flush(self) -> bool:
+        """Flush if the interval elapsed (always, at interval 0).
+        Cheap enough for per-contig call sites; returns True when a
+        snapshot was published."""
+        now = time.perf_counter()
+        if self._last_flush >= 0.0 and \
+                now - self._last_flush < self.interval_s:
+            return False
+        self.flush()
+        return True
+
+    def flush(self, final: bool = False) -> None:
+        """Snapshot the registry and atomically republish the shard.
+
+        ``final`` marks the run-exit snapshot (normal exit or SIGTERM
+        teardown); after it the writer goes inert so late teardown
+        paths can call it unconditionally.
+        """
+        with self._lock:
+            if self._final:
+                return
+            self._final = bool(final)
+            rec = {
+                "schema": SNAPSHOT_SCHEMA,
+                "seq": len(self._records),
+                "worker_id": self.worker_id,
+                "run_fp": self.run_fp,
+                "unix_time": round(time.time(), 3),
+                "wall_s": round(time.perf_counter() - self._t0, 3),
+                "final": bool(final),
+                "metrics": self._reg.snapshot(),
+            }
+            self._records.append(rec)
+            data = b"".join(
+                json.dumps(r, sort_keys=True,
+                           separators=(",", ":")).encode() + b"\n"
+                for r in self._records)
+            if maybe_torn("obs/snapshot"):
+                # The drill: tear THIS write. Bypass the atomic publish
+                # (tmp+rename can't tear — that's the point of it) and
+                # leave a truncated shard at the final path, durable,
+                # then die without cleanup. The aggregator must recover
+                # every record before the tear.
+                torn = data[:max(1, len(data) - 17)]
+                with open(self.path, "wb") as fh:
+                    fh.write(torn)
+                    fh.flush()
+                    os.fsync(fh.fileno())
+                hard_exit(137)
+            atomic_write_bytes(self.path, data)
+            self._last_flush = time.perf_counter()
+
+
+# One writer per process, installed by the CLI/worker at join time so
+# library code (and teardown paths) can flush without plumbing.
+_WRITER: Optional[WorkerMetricsWriter] = None
+
+
+def install_writer(directory: str, worker_id: str, run_fp: str,
+                   reg: Optional[MetricsRegistry] = None,
+                   interval_s: Optional[float] = None
+                   ) -> WorkerMetricsWriter:
+    """Install (and immediately flush) the process metrics writer.
+    The eager first flush publishes the shard at join time, so a
+    worker evicted before its first contig still appears in the fleet
+    model."""
+    global _WRITER
+    _WRITER = WorkerMetricsWriter(directory, worker_id, run_fp,
+                                  reg=reg, interval_s=interval_s)
+    _WRITER.flush()
+    return _WRITER
+
+
+def get_writer() -> Optional[WorkerMetricsWriter]:
+    return _WRITER
+
+
+def maybe_flush() -> None:
+    """Periodic-flush hook for hot paths; no-op without a writer."""
+    if _WRITER is not None:
+        _WRITER.maybe_flush()
+
+
+def flush_final() -> None:
+    """Final-snapshot hook for exit paths (normal return, SIGTERM
+    teardown). Idempotent; no-op without a writer."""
+    if _WRITER is not None:
+        _WRITER.flush(final=True)
+
+
+# ----------------------------------------------------------- aggregation
+
+def obs_dir_for(root: str) -> str:
+    """The worker-shard directory for ``root``: its ``obs/`` subdir
+    when present (a ledger dir), else ``root`` itself (a bare
+    RACON_TPU_OBS_DIR)."""
+    sub = os.path.join(root, OBS_SUBDIR)
+    return sub if os.path.isdir(sub) else root
+
+
+def load_worker_shards(obs_dir: str) -> List[Dict]:
+    """Read every ``worker_*.metrics.jsonl`` shard under ``obs_dir``,
+    torn-tolerantly: a truncated tail (the obs/snapshot drill, or a
+    mid-write power cut on a non-atomic filesystem) drops only the
+    torn record. Returns ``[{path, records, clean}, ...]`` sorted by
+    filename; shards with no recoverable record are skipped."""
+    shards = []
+    try:
+        names = sorted(os.listdir(obs_dir))
+    except OSError:
+        return shards
+    for name in names:
+        if not (name.startswith("worker_") and
+                name.endswith(SHARD_SUFFIX)):
+            continue
+        path = os.path.join(obs_dir, name)
+        records, clean = load_jsonl_prefix(path)
+        records = [r for r in records
+                   if r.get("schema") == SNAPSHOT_SCHEMA and
+                   isinstance(r.get("metrics"), dict) and
+                   "worker_id" in r and "run_fp" in r]
+        if records:
+            shards.append({"path": path, "records": records,
+                           "clean": clean})
+    return shards
+
+
+def _compress_timeline(events: List[Dict]) -> Dict[str, List[Dict]]:
+    """Group ledger events by shard name into per-shard timelines,
+    collapsing each consecutive run of renews by one worker into a
+    single ``{"ev": "renew", "n": count, ...}`` entry — a shard
+    polishing hundreds of contigs renews per contig, and the timeline
+    is for humans."""
+    timeline: Dict[str, List[Dict]] = {}
+    for rec in events:
+        name = rec.get("name")
+        ev = rec.get("ev")
+        if not isinstance(name, str) or ev not in ("claim", "renew",
+                                                   "steal", "complete"):
+            continue
+        lane = timeline.setdefault(name, [])
+        if ev == "renew" and lane and lane[-1]["ev"] == "renew" and \
+                lane[-1].get("worker") == rec.get("worker"):
+            lane[-1]["n"] += 1
+            lane[-1]["t_last"] = rec.get("t")
+            continue
+        entry = {"ev": ev, "worker": rec.get("worker"),
+                 "t": rec.get("t")}
+        if ev == "renew":
+            entry["n"] = 1
+            entry["t_last"] = rec.get("t")
+        if ev == "steal":
+            entry["victim"] = rec.get("victim")
+            entry["expired_for_s"] = rec.get("expired_for_s")
+        if "epoch" in rec:
+            entry["epoch"] = rec.get("epoch")
+        lane.append(entry)
+    return timeline
+
+
+def aggregate(root: str) -> Dict:
+    """Merge every worker metric shard under ``root`` (plus the
+    ledger's ``events.jsonl`` when present) into one fleet model::
+
+        {"run_fp": ..., "n_workers": N,
+         "workers": {wid: {"seq", "wall_s", "final", "clean",
+                           "unix_time", "windows_per_sec",
+                           "phase_seconds": {...}, "metrics": {...}}},
+         "fleet":   {key: merged value},     # merge_kind() semantics
+         "timeline": {shard: [lease events]},
+         "steals": total}
+
+    Raises :class:`FleetObsError` when no shard is readable or when
+    shards carry different run fingerprints.
+    """
+    obs_dir = obs_dir_for(root)
+    shards = load_worker_shards(obs_dir)
+    if not shards:
+        raise FleetObsError(
+            f"[racon_tpu::fleet] no worker metric shards under "
+            f"{obs_dir!r} — was the fleet run with fleet obs enabled "
+            "(ledger workers write them automatically; serial runs "
+            f"need {ENV_OBS_DIR})?")
+    fps = sorted({sh["records"][-1]["run_fp"] for sh in shards})
+    if len(fps) > 1:
+        raise FleetObsError(
+            f"[racon_tpu::fleet] refusing to merge shards from "
+            f"different runs: {obs_dir!r} holds run_fp "
+            f"{', '.join(fp[:12] for fp in fps)} — stale shards from "
+            "a previous run share this directory; clear it or point "
+            "at a fresh one")
+    workers: Dict[str, Dict] = {}
+    for sh in shards:
+        last = sh["records"][-1]
+        wid = str(last["worker_id"])
+        metrics = last["metrics"]
+        wall = float(last.get("wall_s", 0.0))
+        windows = metrics.get("poa_windows_total", 0)
+        phase = {k[len("phase_seconds_"):]: v
+                 for k, v in metrics.items()
+                 if k.startswith("phase_seconds_") and
+                 k != "phase_seconds_total"}
+        workers[wid] = {
+            "seq": last.get("seq"),
+            "wall_s": wall,
+            "final": bool(last.get("final")),
+            "clean": bool(sh["clean"]),
+            "unix_time": last.get("unix_time"),
+            "windows_per_sec": (round(windows / wall, 3)
+                                if wall > 0 and windows else 0.0),
+            "phase_seconds": phase,
+            "metrics": metrics,
+        }
+    keys = sorted({k for w in workers.values() for k in w["metrics"]})
+    order = sorted(workers)
+    fleet = {}
+    for key in keys:
+        merged = merge_values(
+            key, [workers[w]["metrics"].get(key) for w in order])
+        if merged is not None:
+            fleet[key] = merged
+    events_path = os.path.join(root, "events.jsonl")
+    events: List[Dict] = []
+    if os.path.exists(events_path):
+        events, _ = load_jsonl_prefix(events_path)
+    timeline = _compress_timeline(events)
+    steals = sum(1 for rec in events if rec.get("ev") == "steal")
+    return {
+        "run_fp": fps[0],
+        "n_workers": len(workers),
+        "workers": workers,
+        "fleet": fleet,
+        "timeline": timeline,
+        "steals": steals,
+    }

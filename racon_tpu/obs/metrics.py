@@ -69,6 +69,17 @@ class MetricsRegistry:
             if cur is None or value > cur:
                 self._v[key] = value
 
+    def apply(self, fn) -> None:
+        """Run ``fn(values_dict)`` under the registry lock — the single
+        mutation point for multi-key read-modify-write updates. A
+        recorder that composes ``get``/``inc``/``set`` instead takes
+        and releases the lock per call, and two pipeline/streaming
+        threads interleaving between those calls drop updates or
+        publish a ratio computed from mismatched numerator/denominator
+        reads."""
+        with self._lock:
+            fn(self._v)
+
     def get(self, key: str, default: object = 0) -> object:
         with self._lock:
             return self._v.get(key, default)
@@ -287,13 +298,20 @@ def record_ovl(device_jobs: int, native_jobs: int, tiles: int,
     number for ROADMAP item 3 (it was pinned ~0 for ultralong inputs
     before the tiled path existed)."""
     reg = reg if reg is not None else _REGISTRY
-    reg.inc("ovl_device_jobs", int(device_jobs))
-    reg.inc("ovl_native_jobs", int(native_jobs))
-    reg.inc("ovl_tiles_exec", int(tiles))
-    total = reg.get("ovl_device_jobs") + reg.get("ovl_native_jobs")
-    if total > 0:
-        reg.set("ovl_device_fraction",
-                round(reg.get("ovl_device_jobs") / total, 4))
+
+    def _mutate(v):
+        # One lock for the whole read-modify-write: the device fraction
+        # must be derived from the same totals its increments produced,
+        # and ovl batches land concurrently from pipeline stage threads.
+        v["ovl_device_jobs"] = v.get("ovl_device_jobs", 0) + int(device_jobs)
+        v["ovl_native_jobs"] = v.get("ovl_native_jobs", 0) + int(native_jobs)
+        v["ovl_tiles_exec"] = v.get("ovl_tiles_exec", 0) + int(tiles)
+        total = v["ovl_device_jobs"] + v["ovl_native_jobs"]
+        if total > 0:
+            v["ovl_device_fraction"] = round(
+                v["ovl_device_jobs"] / total, 4)
+
+    reg.apply(_mutate)
 
 
 def record_align_phase(seconds: float,
@@ -436,6 +454,111 @@ def sched_extras(reg: Optional[MetricsRegistry] = None
     """The registry's sched_* keys as a JSON-ready dict (bench extras)."""
     reg = reg if reg is not None else _REGISTRY
     return {k: reg.get(k) for k in SCHED_KEYS}
+
+
+# ------------------------------------------------- fleet merge semantics
+
+#: Merge kinds for cross-worker aggregation (racon_tpu/obs/fleet.py).
+#: Every registry key has exactly one kind, decided by
+#: :func:`merge_kind`, so the fleet aggregator never guesses:
+#:
+#: - ``sum``  — monotone counters (bytes, events, seconds of work);
+#:   the fleet value is the sum over workers.
+#: - ``max``  — peak gauges (queue depths); fleet value is the max.
+#: - ``last`` — point-in-time gauges and per-run snapshots (fleet
+#:   shape, cache population, derived ratios, structured sched
+#:   telemetry); summing them across workers would be meaningless, so
+#:   the most recent worker snapshot wins.
+MERGE_SUM = "sum"
+MERGE_MAX = "max"
+MERGE_LAST = "last"
+
+#: Exact keys whose fleet merge is ``last`` (point-in-time gauges).
+#: ``sched_flag_pulls``/``sched_flag_pull_s`` are NOT here — despite
+#: the prefix they are inc'd counters, so they sum.
+_MERGE_LAST_KEYS = frozenset({
+    "dist_workers", "dist_shards", "dist_n_targets",
+    "ovl_device_fraction", "walk_chain_len",
+    "pipe_overlap_efficiency",
+    "jax_cache_enabled", "jax_cache_entries_start",
+    "jax_cache_entries_added",
+    "sched_rounds", "sched_windows", "sched_chunks",
+    "sched_rounds_hist", "sched_survivor_frac",
+    "sched_rounds_saved_frac", "sched_repack_overhead_s",
+    "sched_dispatches_saved",
+})
+
+
+def merge_kind(key: str) -> str:
+    """The fleet merge kind for a registry key (docs/OBSERVABILITY.md
+    documents the table). Unknown keys default to ``sum`` — new
+    counters aggregate correctly without registration; a new gauge must
+    be added to ``_MERGE_LAST_KEYS`` (or end in ``_peak``) or the fleet
+    number is wrong, which tests/test_fleet_obs.py pins for the known
+    key set."""
+    if key in _MERGE_LAST_KEYS:
+        return MERGE_LAST
+    if key.endswith("_peak"):
+        return MERGE_MAX
+    return MERGE_SUM
+
+
+def merge_values(key: str, values) -> object:
+    """Fold per-worker values for ``key`` by its merge kind. Non-numeric
+    values (sched hist dicts, fraction lists) always take the last —
+    there is no meaningful sum/max for them."""
+    vals = [v for v in values if v is not None]
+    if not vals:
+        return None
+    kind = merge_kind(key)
+    numeric = all(isinstance(v, (int, float)) and
+                  not isinstance(v, bool) for v in vals)
+    if not numeric or kind == MERGE_LAST:
+        return vals[-1]
+    if kind == MERGE_MAX:
+        return max(vals)
+    total = sum(vals)
+    return round(total, 6) if isinstance(total, float) else total
+
+
+# -------------------------------------------------- phases and windows
+
+def _phase_slug(msg: str) -> str:
+    """Registry-key slug for a logger phase message:
+    ``"[racon_tpu::Polisher::initialize] loaded sequences"`` ->
+    ``"initialize_loaded_sequences"``."""
+    msg = msg.strip()
+    if msg.startswith("[") and "]" in msg:
+        head, _, rest = msg.partition("]")
+        msg = head[1:].rsplit("::", 1)[-1] + " " + rest
+    out = []
+    for ch in msg.lower():
+        out.append(ch if ch.isalnum() else "_")
+    slug = "_".join(filter(None, "".join(out).split("_")))
+    return slug[:64] or "unnamed"
+
+
+def record_phase_seconds(msg: str, seconds: float,
+                         reg: Optional[MetricsRegistry] = None) -> None:
+    """Account one completed logger phase (utils/logger.py) as
+    ``phase_seconds_<slug>`` plus the ``phase_seconds_total`` roll-up —
+    the per-worker phase decomposition the fleet aggregator and the
+    OpenMetrics exporter publish (the trace-span equivalent only exists
+    when tracing is on)."""
+    reg = reg if reg is not None else _REGISTRY
+    reg.inc(f"phase_seconds_{_phase_slug(msg)}", float(seconds))
+    reg.inc("phase_seconds_total", float(seconds))
+
+
+def record_windows(n: int,
+                   reg: Optional[MetricsRegistry] = None) -> None:
+    """Account ``n`` polished windows (ops/poa.py consensus_windows).
+    ``poa_windows_total`` is cumulative across chunks, contigs, and
+    shards — unlike ``sched_windows`` (a per-run snapshot overwritten
+    by each polisher instance), so per-worker windows/s in the fleet
+    report divides this by the snapshot's wall clock."""
+    reg = reg if reg is not None else _REGISTRY
+    reg.inc("poa_windows_total", int(n))
 
 
 def sched_summary_line(reg: Optional[MetricsRegistry] = None) -> str:

@@ -76,6 +76,9 @@ class NullTracer:
               **attrs) -> None:
         pass
 
+    def set_context(self, **attrs) -> None:
+        pass
+
     def finish(self, metrics: Optional[dict] = None) -> None:
         pass
 
@@ -141,6 +144,9 @@ class Tracer:
         self._lock = threading.Lock()
         self._local = threading.local()
         self._next_id = 0
+        # Process-wide span attributes (worker_id/shard/run_fp) merged
+        # into every span record; explicit span attrs win on key clash.
+        self._context: dict = {}
         self._xprof = os.environ.get(ENV_XPROF, "") not in ("", "0",
                                                             "false")
         # Spans stream to a ``.part`` sidecar; finish() promotes it to
@@ -185,7 +191,8 @@ class Tracer:
         self._write({"ev": "span", "id": span.id, "parent": span.parent,
                      "kind": span.kind, "name": span.name,
                      "t0": round(span.t0 - self._t0, 6),
-                     "dur_s": round(dur, 6), **span.attrs})
+                     "dur_s": round(dur, 6),
+                     **self._context, **span.attrs})
 
     # ------------------------------------------------------------ public API
 
@@ -206,13 +213,27 @@ class Tracer:
         self._write({"ev": "span", "id": sid, "parent": parent,
                      "kind": kind, "name": name,
                      "t0": round(max(t0_perf - self._t0, 0.0), 6),
-                     "dur_s": round(max(dur_s, 0.0), 6), **attrs})
+                     "dur_s": round(max(dur_s, 0.0), 6),
+                     **self._context, **attrs})
 
     def point(self, kind: str, name: str, dur_s: float = 0.0,
               **attrs) -> None:
         """Record an instantaneous-ish event (e.g. one transfer) ending
         now, with ``dur_s`` of lead time."""
         self.emit(kind, name, time.perf_counter() - dur_s, dur_s, **attrs)
+
+    def set_context(self, **attrs) -> None:
+        """Merge process-wide attributes (``worker_id``/``shard``/
+        ``run_fp``) into every subsequent span record. ``None`` values
+        drop the key — workers call ``set_context(shard=None)`` when a
+        lease is released. Explicit per-span attrs shadow the context
+        on clashes, so recorders keep full control of their own keys."""
+        with self._lock:
+            for k, v in attrs.items():
+                if v is None:
+                    self._context.pop(k, None)
+                else:
+                    self._context[k] = v
 
     def finish(self, metrics: Optional[dict] = None) -> None:
         """Write a final metrics snapshot, then atomically promote the

@@ -192,6 +192,7 @@ class PoaEngine:
         # backend "jax": device-resident engine; with a mesh, chunks shard
         # their job axis over the mesh's "dp" devices
         # (device_poa.device_round_sharded — one psum per round).
+        from racon_tpu.obs.metrics import record_windows
         if self.backend == "jax":
             dev, host, lq_max, la_max = self._partition_device(active)
             n = 0
@@ -199,8 +200,11 @@ class PoaEngine:
                 n += self._consensus_device(dev, lq_max, la_max)
             if host:
                 n += self._consensus_host(host, force_native=True)
+            record_windows(n)
             return n
-        return self._consensus_host(active)
+        n = self._consensus_host(active)
+        record_windows(n)
+        return n
 
     def _partition_device(self, windows: List[Window]):
         """Split windows into device-engine vs host-path sets.

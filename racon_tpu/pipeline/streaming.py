@@ -140,7 +140,8 @@ def stream_consensus(engine, windows, chunk: int = 8192,
     depth = max(1, int(depth))
     chunk = max(1, int(chunk))
 
-    from racon_tpu.obs.metrics import record_pipeline_wall
+    from racon_tpu.obs.metrics import (record_pipeline_wall,
+                                       record_windows)
     from racon_tpu.obs.trace import get_tracer
     from racon_tpu.sched import sched_enabled
     tracer = get_tracer()
@@ -267,6 +268,10 @@ def stream_consensus(engine, windows, chunk: int = 8192,
                          depth=depth, chunk=chunk):
             with pipe:
                 for item in pipe.drain(q_done):
+                    # Same counter the serial path bumps in
+                    # consensus_windows: active windows only, counted
+                    # after their consensus is applied.
+                    record_windows(len(item.windows))
                     for _sid, s, e in tracker.retire(item.sid):
                         if tick is not None:
                             tick()

@@ -61,8 +61,13 @@ class Logger:
             self._bar = 0
             elapsed = time.perf_counter() - self._phase_t0
             print(f"{msg} {elapsed:.6f} s", file=self.stream)
+        from racon_tpu.obs.metrics import record_phase_seconds
         from racon_tpu.obs.trace import get_tracer
         get_tracer().emit("phase", msg, self._phase_t0, elapsed)
+        # Always-on counterpart of the trace span: per-phase seconds in
+        # the metrics registry feed the fleet aggregator even when
+        # tracing is off (racon_tpu/obs/fleet.py).
+        record_phase_seconds(msg, elapsed)
 
     def tick(self, msg: str) -> None:
         """Advance a 20-step progress bar — ``(*logger)["msg"]``."""

@@ -1,0 +1,227 @@
+"""CI smoke: the fleet observability plane, end to end through real
+processes (racon_tpu/obs/fleet.py, obs/export.py, docs/OBSERVABILITY.md).
+
+The drill: 6 contigs in 3 shards, a 2-worker fleet with one real
+eviction —
+
+  worker A  ``dist/contig:1!term``  SIGTERM'd mid-shard after one
+                                    contig; the teardown contract must
+                                    leave a *final* metric snapshot;
+  worker B  ``skew=99999``          the survivor: steals A's shard,
+                                    finishes every shard, merges.
+
+Both workers run with ``RACON_TPU_OBS_FLUSH_S=0`` (snapshot per
+contig) and ``RACON_TPU_PIPELINE=2`` (streamed execution, so pipe_*
+gauges exist to survive the merge).
+
+Gates:
+- merged FASTA byte-identical to a serial run (the fleet is still a
+  correct polisher while being observed);
+- both workers left metric shards; A's last snapshot is ``final`` (the
+  SIGTERM flush);
+- the merged fleet model's sum-kind counters equal the per-worker sums
+  (checked for every sum key, not a cherry-picked few), and ``dist_*``
+  / ``pipe_*`` / phase-seconds series survive the merge;
+- the OpenMetrics render passes the structural validator, contains
+  ``dist_*``, ``pipe_*``, and phase-seconds families, and is
+  byte-stable across renders;
+- the survivor's trace spans carry ``worker_id``/``run_fp`` context
+  and scripts/obs_report.py renders a ``fleet:`` section for the
+  ledger.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np                                   # noqa: E402
+
+BASES = np.frombuffer(b"ACGT", np.uint8)
+BOOT = ("import sys; from racon_tpu import cli; "
+        "sys.exit(cli.main(sys.argv[1:]))")
+N_CONTIGS = 6
+N_SHARDS = 3
+
+
+def _noisy(rng, truth):
+    out = []
+    for b in truth:
+        r = rng.random()
+        if r < 0.03:
+            continue
+        out.append(int(rng.integers(0, 4)) if r < 0.06 else int(
+            np.searchsorted(BASES, b)))
+    return bytes(BASES[np.array(out)])
+
+
+def _write_inputs(d):
+    rng = np.random.default_rng(23)
+    drafts, reads, paf = [], [], []
+    for c in range(N_CONTIGS):
+        truth = BASES[rng.integers(0, 4, 300 + 30 * c)]
+        draft = _noisy(rng, truth)
+        drafts.append(b">c%d\n%s\n" % (c, draft))
+        for i in range(6):
+            r = _noisy(rng, truth)
+            rid = f"r{c}_{i}"
+            reads.append(b">%s\n%s\n" % (rid.encode(), r))
+            paf.append(f"{rid}\t{len(r)}\t0\t{len(r)}\t+\tc{c}"
+                       f"\t{len(draft)}\t0\t{len(draft)}"
+                       f"\t{min(len(r), len(draft))}"
+                       f"\t{max(len(r), len(draft))}\t60")
+    with open(os.path.join(d, "draft.fasta"), "wb") as fh:
+        fh.write(b"".join(drafts))
+    with open(os.path.join(d, "reads.fasta"), "wb") as fh:
+        fh.write(b"".join(reads))
+    with open(os.path.join(d, "ovl.paf"), "w") as fh:
+        fh.write("\n".join(paf) + "\n")
+
+
+def _cmd(d, *extra):
+    return [sys.executable, "-c", BOOT, "--backend", "jax", *extra,
+            os.path.join(d, "reads.fasta"), os.path.join(d, "ovl.paf"),
+            os.path.join(d, "draft.fasta")]
+
+
+def _env(**overrides):
+    e = dict(os.environ)
+    for k in ("RACON_TPU_FAULTS", "RACON_TPU_TRACE", "RACON_TPU_OBS_DIR",
+              "RACON_TPU_PIPELINE", "RACON_TPU_OBS_FLUSH_S"):
+        e.pop(k, None)
+    e["RACON_TPU_DIST_SHARDS"] = str(N_SHARDS)
+    e.update(overrides)
+    return e
+
+
+def _worker(d, ledger, wid, *, faults=None, trace=None):
+    env = {"RACON_TPU_OBS_FLUSH_S": "0", "RACON_TPU_PIPELINE": "2"}
+    if faults:
+        env["RACON_TPU_FAULTS"] = faults
+    if trace:
+        env["RACON_TPU_TRACE"] = trace
+    return subprocess.Popen(
+        _cmd(d, "--ledger-dir", ledger, "--workers", "2",
+             "--worker-id", wid),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        env=_env(**env))
+
+
+def main():
+    from racon_tpu.obs import export as obs_export
+    from racon_tpu.obs import fleet as obs_fleet
+    from racon_tpu.obs.metrics import MERGE_SUM, merge_kind
+
+    with tempfile.TemporaryDirectory() as d:
+        _write_inputs(d)
+
+        # Serial baseline: the bytes the observed fleet must still emit.
+        proc = subprocess.run(_cmd(d), capture_output=True, env=_env())
+        assert proc.returncode == 0, proc.stderr.decode()
+        base = proc.stdout
+        assert base.count(b">") == N_CONTIGS
+
+        ledger = os.path.join(d, "ledger")
+
+        # Worker A: SIGTERM'd after committing one contig. 143 = the
+        # CLI's orderly teardown ran — which is exactly what the final
+        # metric flush rides on.
+        a = _worker(d, ledger, "A", faults="dist/contig:1!term")
+        a_out, a_err = a.communicate(timeout=300)
+        assert a.returncode == 143, \
+            f"A: expected SIGTERM exit 143, got {a.returncode}: " \
+            f"{a_err.decode()}"
+        assert a_out == b""
+        print("[fleet-obs-smoke] worker A evicted via SIGTERM (143)",
+              flush=True)
+
+        # Worker B: outruns every stale lease, finishes, merges.
+        trace = os.path.join(d, "b.jsonl")
+        b = _worker(d, ledger, "B", faults="skew=99999", trace=trace)
+        b_out, b_err = b.communicate(timeout=300)
+        assert b.returncode == 0, b_err.decode()
+        assert b_out == base, \
+            "merged FASTA differs from single-process serial run"
+        print("[fleet-obs-smoke] worker B stole, finished, merged "
+              "(byte-identical to serial)", flush=True)
+
+        # ---- worker metric shards.
+        obs_dir = os.path.join(ledger, obs_fleet.OBS_SUBDIR)
+        shards = obs_fleet.load_worker_shards(obs_dir)
+        assert len(shards) == 2, \
+            f"expected 2 worker shards in {obs_dir}: {shards}"
+
+        model = obs_fleet.aggregate(ledger)
+        assert model["n_workers"] == 2, model["workers"].keys()
+        assert model["workers"]["A"]["final"], \
+            "evicted worker A left no final (SIGTERM-flushed) snapshot"
+        assert model["workers"]["B"]["final"]
+        assert model["workers"]["B"]["windows_per_sec"] > 0
+
+        # Sum-kind counters must equal the per-worker sums — every key,
+        # not a cherry-picked few.
+        workers = model["workers"]
+        for key, merged in model["fleet"].items():
+            if merge_kind(key) != MERGE_SUM or \
+                    not isinstance(merged, (int, float)):
+                continue
+            expect = sum(w["metrics"].get(key, 0) for w in
+                         workers.values())
+            assert abs(merged - expect) < 1e-6, \
+                f"fleet[{key}] = {merged} != per-worker sum {expect}"
+        for prefix in ("dist_", "pipe_", "phase_seconds_",
+                       "poa_windows"):
+            assert any(k.startswith(prefix) for k in model["fleet"]), \
+                f"no {prefix}* metric survived the merge: " \
+                f"{sorted(model['fleet'])}"
+        # The eviction shows in the lease timeline.
+        assert model["steals"] >= 1, model["timeline"]
+        print(f"[fleet-obs-smoke] fleet model: {model['n_workers']} "
+              f"workers, {model['steals']} steal(s), "
+              f"{len(model['fleet'])} merged metrics (sums verified)",
+              flush=True)
+
+        # ---- OpenMetrics render: valid, complete, byte-stable.
+        text = obs_export.render_fleet(model)
+        errors = obs_export.validate_openmetrics(text)
+        assert not errors, "invalid OpenMetrics:\n" + "\n".join(errors)
+        for needle in ("racon_tpu_dist_", "racon_tpu_pipe_",
+                       "racon_tpu_phase_seconds",
+                       "racon_tpu_worker_windows_per_sec"):
+            assert needle in text, f"missing {needle} series:\n{text}"
+        assert text == obs_export.render_fleet(
+            obs_fleet.aggregate(ledger)), \
+            "OpenMetrics render is not byte-stable"
+        rc = __import__("scripts.obs_export", fromlist=["main"]).main(
+            [ledger, "--validate", "--out", os.path.join(d, "m.prom")])
+        assert rc == 0, "scripts/obs_export.py --validate failed"
+        print("[fleet-obs-smoke] OpenMetrics render valid and "
+              "byte-stable", flush=True)
+
+        # ---- span context: B's spans carry worker identity.
+        from scripts import obs_report
+        tr = obs_report.load_trace(trace)
+        errs = obs_report.validate(tr)
+        assert not errs, "trace schema violations:\n" + "\n".join(errs)
+        tagged = [s for s in tr["spans"].values()
+                  if s.get("worker_id") == "B"]
+        assert tagged, "no span carries worker_id context"
+        assert all("run_fp" in s for s in tagged)
+        assert any(isinstance(s.get("shard"), int) for s in tagged), \
+            "no span carries the claimed-shard context"
+        import io
+        buf = io.StringIO()
+        obs_report.render(tr, out=buf, fleet_dir=ledger)
+        assert "fleet:" in buf.getvalue(), buf.getvalue()
+        print("[fleet-obs-smoke] spans tagged with worker context; "
+              "report renders fleet section", flush=True)
+
+    print("[fleet-obs-smoke] PASS", flush=True)
+
+
+if __name__ == "__main__":
+    main()

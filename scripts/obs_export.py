@@ -1,0 +1,79 @@
+"""One-shot OpenMetrics emit for a fleet run (docs/OBSERVABILITY.md).
+
+Aggregates the worker metric shards (plus ``events.jsonl``) under a
+ledger / obs directory into the fleet model (racon_tpu/obs/fleet.py)
+and renders it as OpenMetrics text (racon_tpu/obs/export.py)::
+
+    python scripts/obs_export.py <ledger-or-obs-dir>            # stdout
+    python scripts/obs_export.py <dir> --out metrics.prom       # file
+    python scripts/obs_export.py <dir> --validate               # gate
+    python scripts/obs_export.py <dir> --json                   # model
+
+``--validate`` re-parses the rendered text with the structural
+OpenMetrics checker and exits 1 on any problem — the CI smoke's gate.
+``--json`` dumps the aggregated fleet model instead (the same dict the
+``fleet:`` section of scripts/obs_report.py formats). For a *live*
+scrape of a running worker use ``RACON_TPU_METRICS_PORT`` instead —
+this script is the offline path.
+"""
+
+import json
+import os
+import sys
+from typing import List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from racon_tpu.obs.export import (render_fleet,            # noqa: E402
+                                  validate_openmetrics)
+from racon_tpu.obs.fleet import FleetObsError, aggregate   # noqa: E402
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    out_path = None
+    if "--out" in argv:
+        i = argv.index("--out")
+        try:
+            out_path = argv[i + 1]
+        except IndexError:
+            print("obs_export: --out needs a path", file=sys.stderr)
+            return 2
+        del argv[i:i + 2]
+    want_validate = "--validate" in argv
+    want_json = "--json" in argv
+    argv = [a for a in argv if a not in ("--validate", "--json")]
+    paths = [a for a in argv if not a.startswith("--")]
+    if len(paths) != 1 or len(argv) != len(paths):
+        print("usage: obs_export.py <ledger-or-obs-dir> "
+              "[--out FILE] [--validate] [--json]", file=sys.stderr)
+        return 2
+
+    try:
+        model = aggregate(paths[0])
+    except FleetObsError as exc:
+        print(str(exc), file=sys.stderr)
+        return 1
+    if want_json:
+        text = json.dumps(model, sort_keys=True, indent=2) + "\n"
+    else:
+        text = render_fleet(model)
+        if want_validate:
+            errors = validate_openmetrics(text)
+            if errors:
+                for e in errors:
+                    print(f"obs_export: INVALID: {e}", file=sys.stderr)
+                return 1
+    if out_path:
+        from racon_tpu.utils.atomicio import atomic_write_text
+        atomic_write_text(out_path, text)
+        print(f"obs_export: wrote {len(text)} bytes to {out_path}",
+              file=sys.stderr)
+    else:
+        sys.stdout.write(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -8,11 +8,21 @@ arithmetic every perf round. A trace (RACON_TPU_TRACE=<path> or
 Usage:
     python scripts/obs_report.py TRACE.jsonl            # breakdown table
     python scripts/obs_report.py TRACE.jsonl --validate # schema check
+    python scripts/obs_report.py TRACE.jsonl --fleet LEDGER_DIR
+                                         # + per-shard lease timeline,
+                                         #   steals, per-worker rates
 
 ``--validate`` exits non-zero unless the trace is well-formed: a begin
 header, JSON-parseable lines, required span keys, non-negative timings,
-parents that exist, and children contained in their parent's interval
-(the contract documented in docs/OBSERVABILITY.md; ci.sh gates it).
+parents that exist, children contained in their parent's interval, and
+well-typed fleet context attrs (``worker_id``/``shard``/``run_fp`` —
+one run fingerprint per trace) (the contract documented in
+docs/OBSERVABILITY.md; ci.sh gates it).
+
+``--fleet`` aggregates the worker metric shards + events.jsonl under a
+ledger directory (racon_tpu/obs/fleet.py) into a ``fleet:`` section;
+shards stamped by different run fingerprints are a hard error, never a
+silent merge.
 """
 
 from __future__ import annotations
@@ -99,6 +109,20 @@ def validate(tr: Dict[str, object]) -> List[str]:
             if k not in s:
                 errs.append(f"span {sid}: kind {s.get('kind')!r} missing "
                             f"attr {k!r}")
+        # Fleet context attrs (set_context, racon_tpu/obs/trace.py):
+        # optional, but when present they must be usable by the fleet
+        # aggregation — a mistyped worker_id/shard silently breaks the
+        # per-worker grouping downstream.
+        if "worker_id" in s and not isinstance(s["worker_id"], str):
+            errs.append(f"span {sid}: worker_id must be a string, got "
+                        f"{s['worker_id']!r}")
+        if "shard" in s and (not isinstance(s["shard"], int) or
+                             isinstance(s["shard"], bool)):
+            errs.append(f"span {sid}: shard must be an integer, got "
+                        f"{s['shard']!r}")
+        if "run_fp" in s and not isinstance(s["run_fp"], str):
+            errs.append(f"span {sid}: run_fp must be a string, got "
+                        f"{s['run_fp']!r}")
         parent = s.get("parent")
         if parent is not None:
             p = spans.get(parent)
@@ -111,6 +135,12 @@ def validate(tr: Dict[str, object]) -> List[str]:
                 if s["t0"] + s["dur_s"] > \
                         p["t0"] + p["dur_s"] + EPS:
                     errs.append(f"span {sid}: ends after parent {parent}")
+    fps = sorted({s["run_fp"] for s in spans.values()
+                  if isinstance(s.get("run_fp"), str)})
+    if len(fps) > 1:
+        errs.append("mixed run_fp across spans: " +
+                    ", ".join(fp[:12] for fp in fps) +
+                    " — one trace must belong to one run")
     return errs
 
 
@@ -127,8 +157,14 @@ def _agg(rows: List[dict]):
     return len(rows), total
 
 
-def render(tr: Dict[str, object], out=sys.stdout) -> None:
+def render(tr: Dict[str, object], out=None,
+           fleet_dir: Optional[str] = None) -> None:
     """Print the per-stage breakdown (the PROFILE.md table, automated)."""
+    if out is None:
+        # Resolved at call time, not def time: test harnesses (capsys)
+        # swap sys.stdout per test, and this module may have been
+        # imported under a different one.
+        out = sys.stdout
     spans: Dict[int, dict] = tr["spans"]
     if not spans:
         print("(empty trace: no spans)", file=out)
@@ -191,6 +227,8 @@ def render(tr: Dict[str, object], out=sys.stdout) -> None:
     _render_pipeline(m, out)
     _render_resilience(m, by_kind, out)
     _render_dist(m, by_kind, out)
+    if fleet_dir:
+        _render_fleet(fleet_dir, out)
     _render_redo(m, out)
     if m:
         keys = [k for k in sorted(m) if k != "ev"]
@@ -322,6 +360,61 @@ def _render_dist(m, by_kind, out) -> None:
         print(f"  events by worker: {workers}", file=out)
 
 
+def _render_fleet(fleet_dir: str, out) -> None:
+    """The "Fleet" section (``--fleet LEDGER_DIR``): the cross-worker
+    view from the worker metric shards + events.jsonl — per-worker
+    rates, merged counters, and the per-shard lease timeline
+    (claim/renew/steal/complete, renew runs compressed). Mixed-run
+    shard directories raise FleetObsError in the aggregator; main()
+    turns that into a clear exit-1 error."""
+    import os
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from racon_tpu.obs.fleet import aggregate
+    model = aggregate(fleet_dir)
+    print(f"\nfleet: workers={model['n_workers']}  "
+          f"steals={model['steals']}  "
+          f"run_fp={model['run_fp'][:12]}", file=out)
+    print(f"  {'worker':>16}  {'windows/s':>9}  {'wall_s':>8}  "
+          f"{'final':>5}  {'snapshots':>9}", file=out)
+    for wid in sorted(model["workers"]):
+        w = model["workers"][wid]
+        seq = w.get("seq")
+        print(f"  {wid:>16}  {w['windows_per_sec']:>9.1f}  "
+              f"{w['wall_s']:>8.2f}  "
+              f"{'yes' if w['final'] else 'no':>5}  "
+              f"{(seq + 1 if isinstance(seq, int) else '?'):>9}",
+              file=out)
+        phases = w.get("phase_seconds", {})
+        if phases:
+            top = sorted(phases.items(), key=lambda kv: -kv[1])[:3]
+            line = "  ".join(f"{name}={secs:.2f}s"
+                             for name, secs in top)
+            print(f"  {'':>16}  phases: {line}", file=out)
+    timeline = model.get("timeline", {})
+    if timeline:
+        print("  lease timeline:", file=out)
+        t_base = min((e["t"] for lane in timeline.values()
+                      for e in lane if isinstance(e.get("t"),
+                                                  (int, float))),
+                     default=0.0)
+        for name in sorted(timeline):
+            parts = []
+            for e in timeline[name]:
+                t = e.get("t")
+                at = (f"@{t - t_base:.1f}s"
+                      if isinstance(t, (int, float)) else "")
+                if e["ev"] == "renew":
+                    parts.append(f"renew x{e['n']} [{e['worker']}]")
+                elif e["ev"] == "steal":
+                    parts.append(
+                        f"steal [{e['worker']}<-{e.get('victim')}] "
+                        f"{at}")
+                else:
+                    parts.append(f"{e['ev']} [{e['worker']}] {at}")
+            print(f"    {name}: " + " -> ".join(parts), file=out)
+
+
 def _render_redo(m, out) -> None:
     """The "Redo" section: where flagged windows were resolved (the
     on-device wide-band pass vs the host fallback) and the walk's
@@ -346,12 +439,23 @@ def _render_redo(m, out) -> None:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
-    argv = argv if argv is not None else sys.argv[1:]
+    argv = list(argv if argv is not None else sys.argv[1:])
     do_validate = "--validate" in argv
+    argv = [a for a in argv if a != "--validate"]
+    fleet_dir = None
+    if "--fleet" in argv:
+        i = argv.index("--fleet")
+        try:
+            fleet_dir = argv[i + 1]
+        except IndexError:
+            print("[obs_report] error: --fleet needs a ledger/obs "
+                  "directory", file=sys.stderr)
+            return 2
+        del argv[i:i + 2]
     paths = [a for a in argv if not a.startswith("--")]
-    if len(paths) != 1:
-        print("usage: obs_report.py TRACE.jsonl [--validate]",
-              file=sys.stderr)
+    if len(paths) != 1 or len(argv) != len(paths):
+        print("usage: obs_report.py TRACE.jsonl [--validate] "
+              "[--fleet LEDGER_DIR]", file=sys.stderr)
         return 2
     try:
         tr = load_trace(paths[0])
@@ -367,7 +471,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"[obs_report] valid: {len(tr['spans'])} spans, "
               f"schema {tr['begin'].get('schema')}")
         return 0
-    render(tr)
+    try:
+        render(tr, fleet_dir=fleet_dir)
+    except Exception as exc:
+        # FleetObsError (mixed run_fp shards, empty obs dir) and
+        # unreadable ledgers surface as a clear error, never a silent
+        # partial report.
+        from racon_tpu.obs.fleet import FleetObsError
+        if not isinstance(exc, (FleetObsError, OSError)):
+            raise
+        print(f"[obs_report] error: {exc}", file=sys.stderr)
+        return 1
     return 0
 
 

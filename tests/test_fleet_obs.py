@@ -1,0 +1,443 @@
+"""Fleet observability tests: worker metric shards, aggregation,
+OpenMetrics export (racon_tpu/obs/fleet.py, obs/export.py,
+docs/OBSERVABILITY.md)."""
+
+import json
+import os
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from racon_tpu.obs import export as obs_export
+from racon_tpu.obs import fleet as obs_fleet
+from racon_tpu.obs import metrics as obs_metrics
+from racon_tpu.resilience import faults
+
+BASES = np.frombuffer(b"ACGT", np.uint8)
+
+
+@pytest.fixture(autouse=True)
+def fleet_sandbox(monkeypatch):
+    """Keep the process-global injector, registry, and metrics writer
+    out of other tests (and other tests' env out of these)."""
+    for env in (faults.ENV_FAULTS, obs_fleet.ENV_OBS_DIR,
+                obs_fleet.ENV_FLUSH_S, obs_export.ENV_METRICS_PORT,
+                "RACON_TPU_TRACE", "RACON_TPU_DIST_SHARDS",
+                "RACON_TPU_PIPELINE"):
+        monkeypatch.delenv(env, raising=False)
+    faults.configure(None)
+    obs_metrics.reset()
+    obs_fleet._WRITER = None
+    yield
+    faults.configure(None)
+    obs_metrics.reset()
+    obs_fleet._WRITER = None
+
+
+class _Died(BaseException):
+    """Stand-in for os._exit in in-process crash drills."""
+
+
+@pytest.fixture
+def soft_crash(monkeypatch):
+    monkeypatch.setattr(obs_fleet, "hard_exit",
+                        lambda code: (_ for _ in ()).throw(_Died(code)))
+    return _Died
+
+
+def _writer(d, wid="w0", fp="fp1", interval=0.0):
+    reg = obs_metrics.MetricsRegistry()
+    w = obs_fleet.WorkerMetricsWriter(str(d), wid, fp, reg=reg,
+                                      interval_s=interval)
+    return w, reg
+
+
+# --------------------------------------------------------- writer shards
+
+def test_writer_publishes_snapshot_history(tmp_path):
+    w, reg = _writer(tmp_path)
+    reg.inc("dist_claims")
+    w.flush()
+    reg.inc("dist_claims")
+    w.flush(final=True)
+    recs = [json.loads(ln) for ln in
+            open(w.path, "rb").read().splitlines()]
+    assert [r["seq"] for r in recs] == [0, 1]
+    assert [r["final"] for r in recs] == [False, True]
+    assert recs[0]["metrics"]["dist_claims"] == 1
+    assert recs[1]["metrics"]["dist_claims"] == 2
+    assert all(r["worker_id"] == "w0" and r["run_fp"] == "fp1"
+               for r in recs)
+    # After the final snapshot the writer is inert: late teardown paths
+    # can call it unconditionally without growing the history.
+    w.flush()
+    assert len(open(w.path, "rb").read().splitlines()) == 2
+
+
+def test_maybe_flush_honors_interval(tmp_path):
+    w, _ = _writer(tmp_path, interval=3600.0)
+    assert w.maybe_flush()          # first call always publishes
+    assert not w.maybe_flush()      # interval not yet elapsed
+    w.interval_s = 0.0
+    assert w.maybe_flush()          # interval 0 = every call
+
+
+def test_shard_path_sanitizes_worker_id(tmp_path):
+    p = obs_fleet.shard_path(str(tmp_path), "w/0:evil id")
+    assert os.path.dirname(p) == str(tmp_path)
+    assert os.path.basename(p) == "worker_w_0_evil_id.metrics.jsonl"
+
+
+def test_install_writer_flushes_eagerly(tmp_path):
+    obs_fleet.install_writer(str(tmp_path), "w0", "fp1",
+                             reg=obs_metrics.MetricsRegistry(),
+                             interval_s=0.0)
+    # A worker evicted before its first contig still appears.
+    assert len(obs_fleet.load_worker_shards(str(tmp_path))) == 1
+    obs_fleet.flush_final()
+    shards = obs_fleet.load_worker_shards(str(tmp_path))
+    assert shards[0]["records"][-1]["final"]
+
+
+def test_torn_snapshot_recovers_prefix(tmp_path, soft_crash):
+    """The obs/snapshot drill: a torn flush leaves a truncated shard at
+    the *final* path (bypassing atomic publish); the reader must recover
+    every complete record before the tear."""
+    faults.configure("obs/snapshot:2!torn")
+    w, reg = _writer(tmp_path)
+    reg.inc("dist_claims")
+    w.flush()
+    reg.inc("dist_claims")
+    w.flush()
+    reg.inc("dist_claims")
+    with pytest.raises(soft_crash):
+        w.flush()
+    faults.configure(None)
+    shards = obs_fleet.load_worker_shards(str(tmp_path))
+    assert len(shards) == 1
+    assert not shards[0]["clean"]            # the tear is visible
+    recs = shards[0]["records"]
+    assert [r["seq"] for r in recs] == [0, 1]
+    assert recs[-1]["metrics"]["dist_claims"] == 2
+    # The torn shard still aggregates (one worker, last good record).
+    model = obs_fleet.aggregate(str(tmp_path))
+    assert model["fleet"]["dist_claims"] == 2
+    assert not model["workers"]["w0"]["clean"]
+
+
+# ----------------------------------------------------------- aggregation
+
+def _two_worker_dir(tmp_path):
+    wa, ra = _writer(tmp_path, "A", "fp1")
+    ra.inc("dist_claims", 2)
+    ra.inc("poa_windows_total", 30)
+    ra.max("pipe_q_depth_peak", 3)
+    ra.set("sched_windows", 10)
+    ra.inc("phase_seconds_polish", 1.5)
+    ra.inc("phase_seconds_total", 1.5)
+    wa.flush(final=True)
+    wb, rb = _writer(tmp_path, "B", "fp1")
+    rb.inc("dist_claims", 3)
+    rb.inc("poa_windows_total", 50)
+    rb.max("pipe_q_depth_peak", 7)
+    rb.set("sched_windows", 25)
+    rb.inc("phase_seconds_polish", 2.5)
+    rb.inc("phase_seconds_total", 2.5)
+    wb.flush(final=True)
+    return tmp_path
+
+
+def test_aggregate_merges_by_kind(tmp_path):
+    model = obs_fleet.aggregate(str(_two_worker_dir(tmp_path)))
+    assert model["run_fp"] == "fp1"
+    assert model["n_workers"] == 2
+    fleet = model["fleet"]
+    assert fleet["dist_claims"] == 5             # sum
+    assert fleet["poa_windows_total"] == 80      # sum
+    assert fleet["pipe_q_depth_peak"] == 7       # max
+    assert fleet["sched_windows"] == 25          # last (worker order)
+    assert fleet["phase_seconds_total"] == 4.0   # sum
+    for wid, windows in (("A", 30), ("B", 50)):
+        wrk = model["workers"][wid]
+        assert wrk["final"] and wrk["clean"]
+        assert wrk["phase_seconds"] == {"polish": pytest.approx(
+            1.5 if wid == "A" else 2.5)}
+        if wrk["wall_s"] > 0:
+            assert wrk["windows_per_sec"] == pytest.approx(
+                windows / wrk["wall_s"], abs=1e-3)
+
+
+def test_aggregate_prefers_obs_subdir(tmp_path):
+    """A ledger root aggregates from its obs/ subdir; a bare
+    RACON_TPU_OBS_DIR aggregates in place."""
+    sub = tmp_path / obs_fleet.OBS_SUBDIR
+    sub.mkdir()
+    w, reg = _writer(sub, "A", "fp1")
+    reg.inc("dist_claims")
+    w.flush(final=True)
+    assert obs_fleet.aggregate(str(tmp_path))["n_workers"] == 1
+    assert obs_fleet.aggregate(str(sub))["n_workers"] == 1
+
+
+def test_aggregate_refuses_mixed_run_fp(tmp_path):
+    wa, _ = _writer(tmp_path, "A", "fp1")
+    wa.flush()
+    wb, _ = _writer(tmp_path, "B", "fp2")
+    wb.flush()
+    with pytest.raises(obs_fleet.FleetObsError, match="different runs"):
+        obs_fleet.aggregate(str(tmp_path))
+
+
+def test_aggregate_empty_dir_raises(tmp_path):
+    with pytest.raises(obs_fleet.FleetObsError, match="no worker"):
+        obs_fleet.aggregate(str(tmp_path))
+
+
+def test_timeline_compresses_renew_runs(tmp_path):
+    w, _ = _writer(tmp_path, "A", "fp1")
+    w.flush(final=True)
+    events = [
+        {"ev": "claim", "name": "shard_000", "worker": "A", "t": 1.0},
+        {"ev": "renew", "name": "shard_000", "worker": "A", "t": 2.0},
+        {"ev": "renew", "name": "shard_000", "worker": "A", "t": 3.0},
+        {"ev": "renew", "name": "shard_000", "worker": "A", "t": 4.0},
+        {"ev": "steal", "name": "shard_000", "worker": "B",
+         "victim": "A", "t": 9.0, "expired_for_s": 4.0},
+        {"ev": "renew", "name": "shard_000", "worker": "B", "t": 10.0},
+        {"ev": "complete", "name": "shard_000", "worker": "B",
+         "t": 11.0},
+    ]
+    with open(tmp_path / "events.jsonl", "w") as fh:
+        for e in events:
+            fh.write(json.dumps(e) + "\n")
+    model = obs_fleet.aggregate(str(tmp_path))
+    lane = model["timeline"]["shard_000"]
+    assert [e["ev"] for e in lane] == ["claim", "renew", "steal",
+                                       "renew", "complete"]
+    # A's 3 consecutive renews collapsed into one entry; B's renew run
+    # after the steal stays separate (different worker).
+    assert lane[1]["n"] == 3 and lane[1]["t_last"] == 4.0
+    assert lane[3]["n"] == 1
+    assert lane[2]["victim"] == "A"
+    assert model["steals"] == 1
+
+
+# ---------------------------------------------------------- OpenMetrics
+
+def test_render_registry_valid_and_byte_stable():
+    snap = {"dist_claims": 3, "pipe_q_depth_peak": 2.0,
+            "sched_windows": 7, "poa_windows_total": 12,
+            "ovl_device_fraction": 0.75,
+            "sched_rounds_hist": {"2": 5},      # non-numeric: skipped
+            "h2d_bytes": 1024}
+    text = obs_export.render_registry(snap)
+    assert obs_export.validate_openmetrics(text) == []
+    assert text == obs_export.render_registry(dict(snap))
+    # sum keys are counters and get the mandatory _total sample suffix —
+    # not doubled when the registry key already carries it.
+    assert "racon_tpu_dist_claims_total 3" in text
+    assert "racon_tpu_poa_windows_total 12" in text
+    assert "racon_tpu_poa_windows_total_total" not in text
+    assert "# TYPE racon_tpu_poa_windows counter" in text
+    # max/last keys are gauges, ints format without a decimal point.
+    assert "# TYPE racon_tpu_pipe_q_depth_peak gauge" in text
+    assert "racon_tpu_pipe_q_depth_peak 2\n" in text
+    assert "racon_tpu_ovl_device_fraction 0.75" in text
+    assert "sched_rounds_hist" not in text
+    assert text.endswith("# EOF\n")
+
+
+def test_render_fleet_series(tmp_path):
+    model = obs_fleet.aggregate(str(_two_worker_dir(tmp_path)))
+    text = obs_export.render_fleet(model)
+    assert obs_export.validate_openmetrics(text) == []
+    assert "racon_tpu_fleet_workers 2" in text
+    assert 'racon_tpu_worker_windows_per_sec{worker="A"}' in text
+    assert 'racon_tpu_worker_final{worker="B"} 1' in text
+    assert "racon_tpu_dist_claims_total 5" in text
+    assert text == obs_export.render_fleet(
+        obs_fleet.aggregate(str(tmp_path)))
+
+
+def test_validator_catches_structural_breakage():
+    assert obs_export.validate_openmetrics("racon_tpu_x 1\n")
+    bad = ("# HELP racon_tpu_c help\n# TYPE racon_tpu_c counter\n"
+           "racon_tpu_c 1\n# EOF\n")
+    assert any("_total" in e for e in
+               obs_export.validate_openmetrics(bad))
+    bad = ("# HELP racon_tpu_g help\n# TYPE racon_tpu_g gauge\n"
+           "racon_tpu_g nope\n# EOF\n")
+    assert any("non-numeric" in e for e in
+               obs_export.validate_openmetrics(bad))
+    ok = ("# HELP racon_tpu_g help\n# TYPE racon_tpu_g gauge\n"
+          "racon_tpu_g 1\n# EOF\n")
+    assert obs_export.validate_openmetrics(ok) == []
+
+
+def test_pull_endpoint_serves_render():
+    reg = obs_metrics.MetricsRegistry()
+    reg.inc("dist_claims", 4)
+    server = obs_export.serve_metrics(
+        0, lambda: obs_export.render_registry(reg.snapshot()))
+    try:
+        port = server.server_address[1]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as resp:
+            body = resp.read().decode()
+            ctype = resp.headers["Content-Type"]
+        assert ctype == obs_export.CONTENT_TYPE
+        assert "racon_tpu_dist_claims_total 4" in body
+        assert obs_export.validate_openmetrics(body) == []
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+# ----------------------------------------------- registry merge hazards
+
+def test_record_ovl_single_lock_under_contention():
+    """The merge-hazard fix: record_ovl's read-modify-write runs under
+    one registry lock, so concurrent batches neither drop increments
+    nor publish a fraction from mismatched numerator/denominator."""
+    reg = obs_metrics.MetricsRegistry()
+    n_threads, n_iters = 8, 200
+
+    def hammer():
+        for _ in range(n_iters):
+            obs_metrics.record_ovl(3, 1, 2, reg=reg)
+
+    threads = [threading.Thread(target=hammer)
+               for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = reg.snapshot()
+    total = n_threads * n_iters
+    assert snap["ovl_device_jobs"] == 3 * total
+    assert snap["ovl_native_jobs"] == 1 * total
+    assert snap["ovl_tiles_exec"] == 2 * total
+    assert snap["ovl_device_fraction"] == 0.75
+
+
+def test_merge_kind_table():
+    mk = obs_metrics.merge_kind
+    assert mk("dist_claims") == obs_metrics.MERGE_SUM
+    assert mk("poa_windows_total") == obs_metrics.MERGE_SUM
+    # sched_flag_pulls is an inc'd counter despite the sched_ prefix.
+    assert mk("sched_flag_pulls") == obs_metrics.MERGE_SUM
+    assert mk("pipe_q_depth_peak") == obs_metrics.MERGE_MAX
+    assert mk("sched_windows") == obs_metrics.MERGE_LAST
+    assert mk("dist_workers") == obs_metrics.MERGE_LAST
+    assert mk("ovl_device_fraction") == obs_metrics.MERGE_LAST
+    mv = obs_metrics.merge_values
+    assert mv("dist_claims", [2, None, 3]) == 5
+    assert mv("pipe_q_depth_peak", [2, 7, 3]) == 7
+    assert mv("sched_windows", [10, 25]) == 25
+    assert mv("sched_rounds_hist", [{"2": 1}, {"2": 5}]) == {"2": 5}
+    assert mv("dist_claims", [None, None]) is None
+
+
+# ------------------------------------------- span context + report gate
+
+def test_report_validates_fleet_span_attrs(tmp_path):
+    from scripts import obs_report
+    path = tmp_path / "t.jsonl"
+    lines = [
+        {"ev": "begin", "schema": 1, "unix_time": 0.0},
+        {"ev": "span", "id": 1, "parent": None, "kind": "phase",
+         "name": "p", "t0": 0.0, "dur_s": 0.1, "worker_id": 7,
+         "shard": "oops", "run_fp": 12},
+        {"ev": "span", "id": 2, "parent": None, "kind": "phase",
+         "name": "q", "t0": 0.2, "dur_s": 0.1, "worker_id": "A",
+         "shard": 0, "run_fp": "fp1"},
+        {"ev": "span", "id": 3, "parent": None, "kind": "phase",
+         "name": "r", "t0": 0.4, "dur_s": 0.1, "worker_id": "B",
+         "run_fp": "fp2"},
+    ]
+    with open(path, "w") as fh:
+        for ln in lines:
+            fh.write(json.dumps(ln) + "\n")
+    errs = obs_report.validate(obs_report.load_trace(str(path)))
+    assert any("worker_id must be a string" in e for e in errs)
+    assert any("shard must be an integer" in e for e in errs)
+    assert any("run_fp must be a string" in e for e in errs)
+    assert any("mixed run_fp" in e for e in errs)
+
+
+def test_tracer_set_context_tags_spans(tmp_path):
+    from racon_tpu.obs.trace import Tracer
+    path = str(tmp_path / "t.jsonl")
+    tr = Tracer(path)
+    tr.set_context(worker_id="A", run_fp="fp1")
+    with tr.span("phase", "one"):
+        pass
+    tr.set_context(shard=2)
+    with tr.span("phase", "two", shard=5):   # span attrs win
+        pass
+    tr.set_context(shard=None)               # None drops the key
+    with tr.span("phase", "three"):
+        pass
+    tr.finish()
+    spans = {r["name"]: r for r in
+             (json.loads(ln) for ln in open(path))
+             if r.get("ev") == "span"}
+    assert spans["one"]["worker_id"] == "A"
+    assert spans["one"]["run_fp"] == "fp1"
+    assert "shard" not in spans["one"]
+    assert spans["two"]["shard"] == 5
+    assert "shard" not in spans["three"]
+    assert spans["three"]["worker_id"] == "A"
+
+
+# ------------------------------------------------- SIGTERM final flush
+
+def _tiny_inputs(d):
+    rng = np.random.default_rng(7)
+    drafts, reads, paf = [], [], []
+    for c in range(2):
+        truth = BASES[rng.integers(0, 4, 220)]
+        keep = rng.random(len(truth)) > 0.04
+        draft = bytes(truth[keep])
+        drafts.append(b">c%d\n%s\n" % (c, draft))
+        for i in range(4):
+            keep = rng.random(len(truth)) > 0.04
+            r = bytes(truth[keep])
+            rid = f"r{c}_{i}"
+            reads.append(b">%s\n%s\n" % (rid.encode(), r))
+            paf.append(f"{rid}\t{len(r)}\t0\t{len(r)}\t+\tc{c}"
+                       f"\t{len(draft)}\t0\t{len(draft)}"
+                       f"\t{min(len(r), len(draft))}"
+                       f"\t{max(len(r), len(draft))}\t60")
+    (d / "draft.fasta").write_bytes(b"".join(drafts))
+    (d / "reads.fasta").write_bytes(b"".join(reads))
+    (d / "ovl.paf").write_text("\n".join(paf) + "\n")
+
+
+def test_sigterm_leaves_final_snapshot(tmp_path, monkeypatch, capsys):
+    """The eviction contract end to end, in process: a ledger worker
+    SIGTERM'd mid-shard exits 143 through the CLI's orderly teardown,
+    which must publish a *final* metric snapshot before the process
+    goes away."""
+    from racon_tpu import cli
+    _tiny_inputs(tmp_path)
+    ledger = str(tmp_path / "ledger")
+    monkeypatch.setenv("RACON_TPU_DIST_SHARDS", "2")
+    monkeypatch.setenv(obs_fleet.ENV_FLUSH_S, "0")
+    faults.configure("dist/contig:0!term")
+    rc = cli.main(["--backend", "jax", "--ledger-dir", ledger,
+                   "--workers", "1", "--worker-id", "W",
+                   str(tmp_path / "reads.fasta"),
+                   str(tmp_path / "ovl.paf"),
+                   str(tmp_path / "draft.fasta")])
+    capsys.readouterr()
+    assert rc == 143
+    shards = obs_fleet.load_worker_shards(
+        os.path.join(ledger, obs_fleet.OBS_SUBDIR))
+    assert len(shards) == 1
+    last = shards[0]["records"][-1]
+    assert last["worker_id"] == "W"
+    assert last["final"], "SIGTERM teardown did not flush a final " \
+                          "snapshot"
