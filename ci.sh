@@ -65,4 +65,7 @@ python scripts/redo_smoke.py
 echo "[ci] fleet obs smoke (2-worker fleet, 1 eviction, aggregate + OpenMetrics gate)"
 python scripts/fleet_obs_smoke.py
 
+echo "[ci] failslow smoke (choke-point hangs, stage stall, self-eviction + merge byte-diff)"
+python scripts/failslow_smoke.py
+
 echo "[ci] OK"

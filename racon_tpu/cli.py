@@ -162,9 +162,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         # aggregate the ledger dir via scripts/obs_export.py instead.
         from racon_tpu.obs.export import render_registry, serve_metrics
         from racon_tpu.obs.metrics import registry as _reg
+        from racon_tpu.resilience.watchdog import health_snapshot
         try:
             serve_metrics(int(metrics_port),
-                          lambda: render_registry(_reg().snapshot()))
+                          lambda: render_registry(_reg().snapshot()),
+                          health=health_snapshot)
         except (ValueError, OSError) as exc:
             print(f"[racon_tpu::] error: cannot serve metrics on port "
                   f"{metrics_port!r}: {exc}", file=sys.stderr)
@@ -394,6 +396,22 @@ def main(argv: Optional[List[str]] = None) -> int:
         fleet.flush_final()
         tracer.finish(metrics=obs_registry().snapshot())
         return 128 + exc.signum
+    except Exception as exc:
+        # Terminal watchdog breach on the SERIAL path (the ledger loop
+        # handles its own self-eviction before returning): this host is
+        # wedged — flush what we have and exit the distinct self-evict
+        # code so supervisors reschedule elsewhere instead of retrying
+        # here. Anything non-terminal propagates unchanged.
+        from racon_tpu.resilience.watchdog import (EXIT_SELF_EVICT,
+                                                   is_terminal)
+        if not is_terminal(exc):
+            raise
+        out.flush()
+        print(f"[racon_tpu::] terminal watchdog breach — {exc}",
+              file=sys.stderr)
+        fleet.flush_final()
+        tracer.finish(metrics=obs_registry().snapshot())
+        return EXIT_SELF_EVICT
     finally:
         for s, h in old_handlers.items():
             signal.signal(s, h)

@@ -49,9 +49,8 @@ from typing import Dict, Iterator, List, Optional, Tuple
 from racon_tpu.obs.metrics import record_dist
 from racon_tpu.resilience import checkpoint as ckpt
 from racon_tpu.resilience.faults import clock_skew, maybe_fault
-from racon_tpu.utils.atomicio import (append_fsync, atomic_finalize,
-                                      atomic_write_bytes,
-                                      publish_exclusive)
+from racon_tpu.utils.atomicio import (append_fsync, atomic_write_bytes,
+                                      atomic_writer, publish_exclusive)
 
 SCHEMA = 1
 META_NAME = "meta.json"
@@ -369,6 +368,31 @@ class WorkLedger:
         self._event({"ev": "renew", "name": claim.name,
                      "worker": claim.worker, "epoch": claim.epoch})
 
+    def release(self, claim: Claim) -> None:
+        """Hand a held lease back WITHOUT completing it — the self-
+        eviction path (resilience/watchdog.py): a worker that has
+        judged itself wedged unlinks its lease so any thief can claim
+        the shard immediately via the first-claim fast path instead of
+        waiting out the lease term. Committed prefix work stays in the
+        shard's checkpoint store; the successor resumes it
+        byte-identically.
+
+        A foreign nonce on disk means the lease was already stolen —
+        benign (nonce fencing protects completion), so the release is
+        a silent no-op rather than an error on a worker that is
+        already giving up.
+        """
+        cur = self._read_lease(claim.name)
+        if cur is None or cur.get("nonce") != claim.nonce:
+            return
+        try:
+            os.remove(self._lease_path(claim.name))
+        except OSError:
+            return
+        record_dist("releases", claim.shard, claim.worker)
+        self._event({"ev": "release", "name": claim.name,
+                     "worker": claim.worker, "epoch": claim.epoch})
+
     def complete(self, claim: Claim, **info) -> None:
         """Publish the done marker, fenced by a final verify so a stale
         worker can't mark a shard done with a thief mid-recompute."""
@@ -425,16 +449,16 @@ class WorkLedger:
             raise LedgerError(
                 "[racon_tpu::dist] merge requested with shards still "
                 f"pending: {self.pending_shards()}")
-        tmp = f"{self.out_path}.tmp.{os.getpid()}"
         total = emitted = 0
-        with open(tmp, "wb") as fh:
+        with atomic_writer(self.out_path) as fh:
             for _tid, blob in self.iter_merged():
                 if blob is None:
                     continue
+                # Per-blob drill point: a term/kill/raise here proves a
+                # death mid-merge never leaves a torn out.fasta (the
+                # writer unlinks its tmp; the thief redoes the pass).
+                maybe_fault("dist/merge_write")
                 fh.write(blob)
                 total += len(blob)
                 emitted += 1
-            fh.flush()
-            os.fsync(fh.fileno())
-        atomic_finalize(tmp, self.out_path)
         return total, emitted

@@ -213,6 +213,28 @@ def run_worker(*, ledger_dir: str, fingerprint: str,
                   f"shard {claim.shard} — lease stolen while working",
                   file=log)
             continue
+        except BaseException as exc:  # noqa: BLE001 — terminal check only
+            # Fail-slow self-eviction: this host has crossed its
+            # terminal watchdog breach budget, so it hands the shard
+            # back EXPLICITLY (lease release — thieves claim it at the
+            # next poll instead of waiting out the lease term) and
+            # exits with a distinct code. Committed prefix work
+            # survives in the shard store; the successor resumes it
+            # byte-identically. Every other exception propagates so
+            # the process dies exactly as a preempted worker would.
+            from racon_tpu.resilience.watchdog import (EXIT_SELF_EVICT,
+                                                       is_terminal)
+            if not is_terminal(exc):
+                raise
+            ledger.release(claim)
+            record_dist("self_evictions", claim.shard, worker)
+            print(f"[racon_tpu::dist] worker {worker}: self-evicting "
+                  f"from shard {claim.shard} — {exc} (lease released; "
+                  f"exit {EXIT_SELF_EVICT})", file=log)
+            # The CLI tail handles fleet.flush_final() + tracer.finish
+            # on this return value, so the eviction leaves a final obs
+            # snapshot like any clean exit.
+            return EXIT_SELF_EVICT
         finally:
             get_tracer().set_context(shard=None)
             fleet.maybe_flush()

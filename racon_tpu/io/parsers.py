@@ -28,6 +28,7 @@ import numpy as np
 
 from racon_tpu.models.sequence import Sequence
 from racon_tpu.models.overlap import Overlap
+from racon_tpu.resilience.faults import InjectedFault
 
 # Matches the reference's parse chunk size (src/polisher.cpp:22).
 CHUNK_SIZE = 1024 * 1024 * 1024
@@ -80,13 +81,28 @@ class Parser:
         self.path = path
         self._iter: Optional[Iterator] = None
         self._failed = False
+        self._pos = 0
 
     def reset(self) -> None:
         self._iter = None
         self._failed = False
+        self._pos = 0
 
     def _records(self) -> Iterator[Tuple[object, int]]:
         raise NotImplementedError
+
+    def _lines(self, f) -> Iterator[Tuple[bytes, int, int]]:
+        """:func:`_block_lines` plus parser-side bookkeeping: tracks
+        the high-water stream offset so a failure raised by the
+        underlying ``read()`` itself — a truncated gzip member ends in
+        EOFError with no record in hand — still gets a byte offset in
+        its :class:`ParseError`, and arms the ``io/read`` fault site so
+        stream-level failures are drillable deterministically."""
+        from racon_tpu.resilience.faults import maybe_fault
+        for ln, nb, off in _block_lines(f):
+            self._pos = off + nb
+            maybe_fault("io/read")
+            yield ln, nb, off
 
     def parse(self, max_bytes: int = -1) -> Tuple[List[object], bool]:
         """One chunk of records, plus whether more remain.
@@ -116,11 +132,20 @@ class Parser:
             # A mislabelled .gz (or truncated stream) must surface as this
             # parser's own error contract, not a raw gzip exception. Mark
             # the parser failed so a retried parse() cannot masquerade as a
-            # clean EOF.
+            # clean EOF. The offset is the high-water mark of complete
+            # lines — the stream broke at or just past it.
             self._failed = True
             raise ParseError(
                 f"[racon_tpu::io] error: corrupt or mislabelled input file "
-                f"{self.path} ({exc})") from exc
+                f"{self.path} ({exc})", offset=self._pos) from exc
+        except InjectedFault as exc:
+            # The io/read drill (resilience/faults.py) models exactly
+            # the stream-level failure above, so it converts the same
+            # way — typed, offset-bearing, parser poisoned.
+            self._failed = True
+            raise ParseError(
+                f"[racon_tpu::io] error: read failure in {self.path} "
+                f"({exc})", offset=self._pos) from exc
         self._iter = iter(())  # exhausted
         return out, False
 
@@ -181,14 +206,31 @@ def scan_sequence_index(path: str) -> Tuple[int, List[int]]:
     worker skips the pass entirely.
     """
     offsets: List[int] = []
+    hw = [0]                 # high-water offset for stream-level errors
+
+    def _tracked(f) -> Iterator[Tuple[bytes, int, int]]:
+        for ln, nb, off in _block_lines(f):
+            hw[0] = off + nb
+            yield ln, nb, off
+
+    try:
+        return _scan_index(path, offsets, _tracked)
+    except (gzip.BadGzipFile, EOFError, OSError) as exc:
+        raise ParseError(
+            f"[racon_tpu::io] error: corrupt or truncated sequence "
+            f"file {path} ({exc})", offset=hw[0]) from exc
+
+
+def _scan_index(path: str, offsets: List[int],
+                lines_of) -> Tuple[int, List[int]]:
     if path.endswith(_FASTA_EXTS):
         with _open(path) as f:
-            for line, _, off in _block_lines(f):
+            for line, _, off in lines_of(f):
                 if line.startswith(b">"):
                     offsets.append(off)
     elif path.endswith(_FASTQ_EXTS):
         with _open(path) as f:
-            lines = _block_lines(f)
+            lines = lines_of(f)
             while True:
                 header, _, rec_off = next(lines, (None, 0, 0))
                 if header is None:
@@ -233,7 +275,7 @@ class FastaParser(Parser):
         name: Optional[bytes] = None
         chunks: List[bytes] = []
         with _open(self.path) as f:
-            for line, _, off in _block_lines(f):
+            for line, _, off in self._lines(f):
                 if line.startswith(b">"):
                     if name is not None:
                         data = b"".join(chunks)
@@ -254,7 +296,7 @@ class FastaParser(Parser):
 class FastqParser(Parser):
     def _records(self) -> Iterator[Tuple[Sequence, int]]:
         with _open(self.path) as f:
-            lines = _block_lines(f)
+            lines = self._lines(f)
             while True:
                 header, _, rec_off = next(lines, (None, 0, 0))
                 if header is None:
@@ -316,7 +358,7 @@ class MhapParser(Parser):
 
     def _records(self) -> Iterator[Tuple[Overlap, int]]:
         with _open(self.path) as f:
-            for line, nb, off in _block_lines(f):
+            for line, nb, off in self._lines(f):
                 if not line:
                     continue
                 t = line.split()
@@ -338,7 +380,7 @@ class PafParser(Parser):
 
     def _records(self) -> Iterator[Tuple[Overlap, int]]:
         with _open(self.path) as f:
-            for line, nb, off in _block_lines(f):
+            for line, nb, off in self._lines(f):
                 if not line:
                     continue
                 t = line.split(b"\t")
@@ -359,7 +401,7 @@ class SamParser(Parser):
 
     def _records(self) -> Iterator[Tuple[Overlap, int]]:
         with _open(self.path) as f:
-            for line, nb, off in _block_lines(f):
+            for line, nb, off in self._lines(f):
                 if line.startswith(b"@"):
                     continue
                 if not line:

@@ -271,16 +271,39 @@ def validate_openmetrics(text: str) -> List[str]:
 # ---------------------------------------------------------- pull endpoint
 
 def serve_metrics(port: int, render: Callable[[], str],
-                  host: str = "127.0.0.1"):
+                  host: str = "127.0.0.1", health=None):
     """Start a daemon-thread OpenMetrics pull endpoint on ``host:port``
     serving ``render()`` at every path. Returns the server (its
     ``.server_address`` carries the bound port — pass ``port=0`` for an
     ephemeral one). Stdlib-only by design; errors in ``render`` become
-    a 500 so a scrape failure never kills the polisher."""
+    a 500 so a scrape failure never kills the polisher.
+
+    ``health``: optional zero-arg callable returning a JSON-able dict
+    with a ``"status"`` key (watchdog.health_snapshot); when given,
+    ``GET /healthz`` serves it as JSON — 200 while status is ``"ok"``,
+    503 otherwise, so stock HTTP liveness probes can evict a wedged
+    worker without parsing metrics."""
+    import json
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     class Handler(BaseHTTPRequestHandler):
         def do_GET(self):  # noqa: N802 (stdlib naming)
+            if self.path.rstrip("/") == "/healthz" and \
+                    health is not None:
+                try:
+                    snap = health()
+                    body = (json.dumps(snap, sort_keys=True) +
+                            "\n").encode()
+                    code = 200 if snap.get("status") == "ok" else 503
+                except Exception as exc:  # probe must not crash the run
+                    body = f'{{"status": "error: {exc}"}}\n'.encode()
+                    code = 500
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
             try:
                 body = render().encode()
                 code = 200

@@ -61,6 +61,30 @@ ENV_OBS_DIR = "RACON_TPU_OBS_DIR"
 #: cadence deterministic.
 ENV_FLUSH_S = "RACON_TPU_OBS_FLUSH_S"
 DEFAULT_FLUSH_S = 5.0
+#: Straggler threshold: a worker whose windows/s sits below this
+#: fraction of the fleet median (computed over workers that polished
+#: at all) gets ``straggler: true`` in the aggregate model. Merge-only
+#: workers (rate 0) are never flagged — they did no window work to be
+#: slow at.
+ENV_STRAGGLER_FRAC = "RACON_TPU_STRAGGLER_FRAC"
+DEFAULT_STRAGGLER_FRAC = 0.5
+
+
+def straggler_frac() -> float:
+    env = os.environ.get(ENV_STRAGGLER_FRAC, "").strip()
+    if not env:
+        return DEFAULT_STRAGGLER_FRAC
+    try:
+        v = float(env)
+    except ValueError:
+        raise FleetObsError(
+            f"[racon_tpu::fleet] invalid {ENV_STRAGGLER_FRAC}="
+            f"{env!r} (expected a fraction in (0, 1])")
+    if not 0.0 < v <= 1.0:
+        raise FleetObsError(
+            f"[racon_tpu::fleet] invalid {ENV_STRAGGLER_FRAC}={v} "
+            "(expected a fraction in (0, 1])")
+    return v
 
 
 class FleetObsError(ValueError):
@@ -261,7 +285,8 @@ def _compress_timeline(events: List[Dict]) -> Dict[str, List[Dict]]:
         name = rec.get("name")
         ev = rec.get("ev")
         if not isinstance(name, str) or ev not in ("claim", "renew",
-                                                   "steal", "complete"):
+                                                   "steal", "complete",
+                                                   "release"):
             continue
         lane = timeline.setdefault(name, [])
         if ev == "renew" and lane and lane[-1]["ev"] == "renew" and \
@@ -293,7 +318,12 @@ def aggregate(root: str) -> Dict:
                            "phase_seconds": {...}, "metrics": {...}}},
          "fleet":   {key: merged value},     # merge_kind() semantics
          "timeline": {shard: [lease events]},
-         "steals": total}
+         "steals": total, "stragglers": [worker ids]}
+
+    Each worker record also carries ``straggler`` (windows/s below
+    ``RACON_TPU_STRAGGLER_FRAC`` of the fleet median — only computed
+    when >= 2 workers polished windows; merge-only workers are never
+    flagged).
 
     Raises :class:`FleetObsError` when no shard is readable or when
     shards carry different run fingerprints.
@@ -336,6 +366,24 @@ def aggregate(root: str) -> Dict:
             "phase_seconds": phase,
             "metrics": metrics,
         }
+    # Straggler flags: a fleet-slow-worker median comparison needs at
+    # least two workers that actually polished windows.
+    rates = sorted(w["windows_per_sec"] for w in workers.values()
+                   if w["windows_per_sec"] > 0)
+    stragglers: List[str] = []
+    if len(rates) >= 2:
+        mid = len(rates) // 2
+        median = rates[mid] if len(rates) % 2 else \
+            (rates[mid - 1] + rates[mid]) / 2.0
+        cutoff = straggler_frac() * median
+        for wid in sorted(workers):
+            w = workers[wid]
+            w["straggler"] = bool(0 < w["windows_per_sec"] < cutoff)
+            if w["straggler"]:
+                stragglers.append(wid)
+    else:
+        for w in workers.values():
+            w["straggler"] = False
     keys = sorted({k for w in workers.values() for k in w["metrics"]})
     order = sorted(workers)
     fleet = {}
@@ -357,4 +405,5 @@ def aggregate(root: str) -> Dict:
         "fleet": fleet,
         "timeline": timeline,
         "steals": steals,
+        "stragglers": stragglers,
     }

@@ -209,6 +209,35 @@ def record_fault(site: str, index: int, action: str,
                               action=action)
 
 
+def record_watchdog_breach(site: str, deadline_s: float, waited_s: float,
+                           terminal: bool = False,
+                           reg: Optional[MetricsRegistry] = None) -> None:
+    """Account one fail-slow deadline breach
+    (racon_tpu/resilience/watchdog.py) and trace it as a ``watchdog``
+    span; terminal breaches (the self-eviction trigger) additionally
+    bump ``res_watchdog_terminal_total``."""
+    reg = reg if reg is not None else _REGISTRY
+    reg.inc("res_watchdog_breach_total")
+    reg.inc(f"res_watchdog_site_{_site_key(site)}")
+    if terminal:
+        reg.inc("res_watchdog_terminal_total")
+    _trace.get_tracer().point("watchdog", site, dur_s=float(waited_s),
+                              deadline_s=float(deadline_s),
+                              waited_s=round(float(waited_s), 6),
+                              terminal=int(bool(terminal)))
+
+
+def record_stall(window_s: float, n_stages: int,
+                 reg: Optional[MetricsRegistry] = None) -> None:
+    """Account one pipeline stall-detector firing (no stage progressed
+    for a full window) and trace it as a ``stall`` span."""
+    reg = reg if reg is not None else _REGISTRY
+    reg.inc("pipe_stall_events")
+    _trace.get_tracer().point("stall", "pipeline",
+                              window_s=float(window_s),
+                              stages=int(n_stages))
+
+
 def record_degraded(n_windows: int,
                     reg: Optional[MetricsRegistry] = None) -> None:
     """A chunk exhausted its retries and its windows were re-polished

@@ -79,6 +79,12 @@ class NullTracer:
     def set_context(self, **attrs) -> None:
         pass
 
+    def snapshot_stack(self) -> list:
+        return []
+
+    def install_stack(self, stack: list) -> None:
+        pass
+
     def finish(self, metrics: Optional[dict] = None) -> None:
         pass
 
@@ -234,6 +240,21 @@ class Tracer:
                     self._context.pop(k, None)
                 else:
                     self._context[k] = v
+
+    def snapshot_stack(self) -> list:
+        """A COPY of the calling thread's open-span stack, for handing
+        to a helper thread (watchdog guard workers) so spans it emits
+        keep their parents."""
+        return list(self._stack())
+
+    def install_stack(self, stack: list) -> None:
+        """Adopt ``stack`` (from :meth:`snapshot_stack`) as THIS
+        thread's span stack. The list is copied, so a thread abandoned
+        mid-job can never corrupt the donor's stack; spans opened and
+        closed on this thread pop themselves as usual, and spans from
+        the donor stack are parent references only — this thread must
+        not close them."""
+        self._local.stack = list(stack)
 
     def finish(self, metrics: Optional[dict] = None) -> None:
         """Write a final metrics snapshot, then atomically promote the

@@ -223,3 +223,99 @@ def tile_plan(lq: int, lt: int, tiers=None):
             nxt_k = 2
         return TilePlan(lanes, W, T, ch, Lq, Lq // T, max(nxt_k, 1))
     return None
+
+
+# ---------------------------------------------------------------------------
+# Watchdog deadline derivation (fail-slow detection, resilience/watchdog.py).
+#
+# A deadline must be generous enough that legitimate work — a cold
+# compile (~45 s observed), a congested 0.25 MB/s tunnel hour, a d2h
+# pull blocking on a full chunk's compute — never breaches it, yet
+# finite so a wedged call converts into DispatchTimeout within bounded
+# time. Each site class gets an env-tunable BASE covering its fixed
+# costs, plus a geometry term scaled by a pessimistic FLOOR rate:
+#
+#   transfer:  base(direction) + nbytes / (RACON_TPU_DEADLINE_MBPS MB/s)
+#   dispatch:  base + cells / (RACON_TPU_DEADLINE_CELLS_PER_S cells/s)
+#
+# all multiplied by RACON_TPU_DEADLINE_SCALE. A base <= 0 disables the
+# deadline for that class (guard runs inline). Invalid env values are a
+# hard ValueError, same contract as RACON_TPU_WALK_K above.
+# ---------------------------------------------------------------------------
+
+DEADLINE_H2D_ENV = "RACON_TPU_DEADLINE_H2D"
+DEADLINE_D2H_ENV = "RACON_TPU_DEADLINE_D2H"
+DEADLINE_DISPATCH_ENV = "RACON_TPU_DEADLINE_DISPATCH"
+DEADLINE_MBPS_ENV = "RACON_TPU_DEADLINE_MBPS"
+DEADLINE_CELLS_ENV = "RACON_TPU_DEADLINE_CELLS_PER_S"
+DEADLINE_SCALE_ENV = "RACON_TPU_DEADLINE_SCALE"
+
+#: Base deadlines, seconds. d2h is the largest because a result pull
+#: blocks on the whole chunk's residual compute, not just the wire.
+_DEADLINE_BASE_DEFAULTS = {
+    DEADLINE_H2D_ENV: 60.0,
+    DEADLINE_D2H_ENV: 300.0,
+    DEADLINE_DISPATCH_ENV: 300.0,
+}
+#: Floor tunnel bandwidth (MB/s) for the byte-proportional term —
+#: PROFILE.md's worst observed hour is 1.4 MB/s; 0.25 leaves 5x slack.
+_DEADLINE_MBPS_DEFAULT = 0.25
+#: Floor device throughput (dirs cells/s) for the dispatch term. The
+#: CPU interpret path — the slowest executor these kernels ever run
+#: on — still clears this by orders of magnitude.
+_DEADLINE_CELLS_DEFAULT = 2e6
+
+
+def _deadline_env(name: str, default: float) -> float:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        raise ValueError(
+            f"[racon_tpu::budget] {name}={raw!r} invalid — expected a "
+            "number of seconds (<= 0 disables this deadline class)")
+
+
+def _deadline_scale() -> float:
+    s = _deadline_env(DEADLINE_SCALE_ENV, 1.0)
+    if s <= 0:
+        raise ValueError(
+            f"[racon_tpu::budget] {DEADLINE_SCALE_ENV} must be > 0 "
+            "(disable per class with the base vars instead)")
+    return s
+
+
+def transfer_deadline_s(nbytes: int, direction: str) -> float:
+    """Watchdog deadline for one h2d/d2h transfer of ``nbytes``.
+    0.0 disables (base env var <= 0)."""
+    if direction not in ("h2d", "d2h"):
+        raise ValueError(
+            f"[racon_tpu::budget] unknown transfer direction "
+            f"{direction!r}")
+    env = DEADLINE_H2D_ENV if direction == "h2d" else DEADLINE_D2H_ENV
+    base = _deadline_env(env, _DEADLINE_BASE_DEFAULTS[env])
+    if base <= 0:
+        return 0.0
+    mbps = _deadline_env(DEADLINE_MBPS_ENV, _DEADLINE_MBPS_DEFAULT)
+    if mbps <= 0:
+        raise ValueError(
+            f"[racon_tpu::budget] {DEADLINE_MBPS_ENV} must be > 0")
+    return (base + max(int(nbytes), 0) / (mbps * 1e6)) * _deadline_scale()
+
+
+def dispatch_deadline_s(cells: int) -> float:
+    """Watchdog deadline for one device dispatch whose forward planes
+    total ``cells`` dirs cells (B * Lq-or-LA * W-class geometry; 0 for
+    geometry-free sites like the scheduler's flag pulls — the pull syncs
+    on compute, so it shares this class's base). 0.0 disables."""
+    base = _deadline_env(DEADLINE_DISPATCH_ENV,
+                         _DEADLINE_BASE_DEFAULTS[DEADLINE_DISPATCH_ENV])
+    if base <= 0:
+        return 0.0
+    rate = _deadline_env(DEADLINE_CELLS_ENV, _DEADLINE_CELLS_DEFAULT)
+    if rate <= 0:
+        raise ValueError(
+            f"[racon_tpu::budget] {DEADLINE_CELLS_ENV} must be > 0")
+    return (base + max(int(cells), 0) / rate) * _deadline_scale()

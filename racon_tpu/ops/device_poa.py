@@ -847,7 +847,11 @@ def put_chunk_bufs(plan: ChunkPlan, mesh=None) -> Tuple[object, object]:
     # The transfer retries whole: device_put is idempotent from the
     # host buffers, and a RetryExhausted here is the degradation signal
     # the engine catches to route the chunk to the host path.
-    return retry_call("h2d/chunk", _put)
+    from racon_tpu.ops.budget import transfer_deadline_s
+    return retry_call(
+        "h2d/chunk", _put,
+        deadline_s=transfer_deadline_s(job_h.nbytes + win_h.nbytes,
+                                       "h2d"))
 
 
 def dispatch_chunk(plan: ChunkPlan, *, match: int, mismatch: int,
@@ -929,12 +933,18 @@ def dispatch_chunk(plan: ChunkPlan, *, match: int, mismatch: int,
         adaptive = (os.environ.get("RACON_TPU_ADAPTIVE", "")
                     not in ("0", "false")
                     and rounds >= 3 and len(set(sc[:-1])) <= 1)
+        from racon_tpu.ops.budget import dispatch_deadline_s
+        # Deadline scales with the chunk's forward-plane work: B reads
+        # x Lq rows x band (or full LA) columns, once per round.
+        cells = (plan.B * plan.Lq * (band_w if band_w else plan.LA)
+                 * max(rounds, 1))
         packed = retry_call(
             "dispatch/chunk", device_chunk_packed, job_buf, win_buf,
             match=match, mismatch=mismatch, gap=gap, ins_scale=ins_scale,
             Lq=plan.Lq, n_win=plan.n_win, LA=plan.LA,
             pallas=pallas, band_w=band_w, rounds=rounds,
-            adaptive=adaptive, mesh=mesh, nxt_k=nxt_k)
+            adaptive=adaptive, mesh=mesh, nxt_k=nxt_k,
+            deadline_s=dispatch_deadline_s(cells))
         obs_registry().inc("device_dispatches")
         if collect:
             t0 = sync(packed, "compute", t0)
@@ -1009,7 +1019,12 @@ def collect_chunk(plan: ChunkPlan, packed, stats: Optional[dict] = None
         record_d2h(ph.nbytes, time.perf_counter() - t0, name="d2h/chunk")
         return ph
 
-    ph = retry_call("d2h/chunk", _pull)
+    from racon_tpu.ops.budget import transfer_deadline_s
+    # Packed output layout (below): Nw*LA codes + 2*Nw*LA cov(int16)
+    # + 4*Nw alen + Nw ovf + 8 adaptive-round bytes.
+    out_bytes = 3 * plan.n_win * plan.LA + 5 * plan.n_win + 8
+    ph = retry_call("d2h/chunk", _pull,
+                    deadline_s=transfer_deadline_s(out_bytes, "d2h"))
     if stats is not None and "_t_pack" in stats:
         stats["d2h"] = stats.get("d2h", 0.0) + \
             (time.perf_counter() - stats.pop("_t_pack"))

@@ -93,7 +93,11 @@ def shard_align_inputs(mesh: Mesh, q: np.ndarray, t: np.ndarray,
                    time.perf_counter() - t0, name="h2d/align")
         return out
 
-    return retry_call("h2d/align", _put)
+    from racon_tpu.ops.budget import transfer_deadline_s
+    return retry_call(
+        "h2d/align", _put,
+        deadline_s=transfer_deadline_s(
+            q.nbytes + t.nbytes + lq.nbytes + lt.nbytes, "h2d"))
 
 
 def nw_align_batch_sharded(mesh: Mesh, q: np.ndarray, t: np.ndarray,
@@ -116,7 +120,11 @@ def nw_align_batch_sharded(mesh: Mesh, q: np.ndarray, t: np.ndarray,
                    name="d2h/align")
         return ops_h, n_h
 
-    ops_h, n_h = retry_call("d2h/align", _pull)
+    from racon_tpu.ops.budget import transfer_deadline_s
+    # jax arrays expose shape/dtype-derived nbytes without a sync.
+    ops_h, n_h = retry_call(
+        "d2h/align", _pull,
+        deadline_s=transfer_deadline_s(ops.nbytes + n.nbytes, "d2h"))
     return ops_h[:B], n_h[:B]
 
 
@@ -225,7 +233,10 @@ def sp_nw_scores(mesh: Mesh, q: np.ndarray, t: np.ndarray, lq: np.ndarray,
         record_d2h(out_h.nbytes, time.perf_counter() - t0, name="d2h/sp")
         return out_h
 
-    return retry_call("d2h/sp", _pull)[:B]
+    from racon_tpu.ops.budget import transfer_deadline_s
+    return retry_call(
+        "d2h/sp", _pull,
+        deadline_s=transfer_deadline_s(out.nbytes, "d2h"))[:B]
 
 
 @functools.partial(jax.jit,
@@ -336,7 +347,10 @@ def sp_nw_align(mesh: Mesh, q: np.ndarray, t: np.ndarray, lq: np.ndarray,
                    name="d2h/sp")
         return ops_h, n_h
 
-    ops_h, n_h = retry_call("d2h/sp", _pull)
+    from racon_tpu.ops.budget import transfer_deadline_s
+    ops_h, n_h = retry_call(
+        "d2h/sp", _pull,
+        deadline_s=transfer_deadline_s(ops.nbytes + n.nbytes, "d2h"))
     ops_h = ops_h[:B]
     n_h = n_h[:B]
     # Re-right-align to Lq+Lt width if target padding widened the walk.

@@ -88,10 +88,13 @@ def pipeline_depth() -> int:
 
 from racon_tpu.pipeline.queues import (BoundedQueue, PipelineAborted,  # noqa: E402
                                        QueueClosed)
-from racon_tpu.pipeline.stages import Pipeline, StageError  # noqa: E402
+from racon_tpu.pipeline.stages import (ENV_STALL, Pipeline,  # noqa: E402
+                                       PipelineStalled, StageError,
+                                       stall_window_s)
 
 __all__ = [
     "BoundedQueue", "DEFAULT_DEPTH", "ENV_DEPTH", "ENV_PIPELINE",
-    "Pipeline", "PipelineAborted", "QueueClosed", "StageError",
-    "configure", "pipeline_depth", "pipeline_enabled",
+    "ENV_STALL", "Pipeline", "PipelineAborted", "PipelineStalled",
+    "QueueClosed", "StageError", "configure", "pipeline_depth",
+    "pipeline_enabled", "stall_window_s",
 ]

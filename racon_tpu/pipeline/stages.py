@@ -31,12 +31,32 @@ efficiency — device-busy over wall — falls out of these numbers
 
 from __future__ import annotations
 
+import os
+import sys
 import threading
 import time
 from typing import Callable, Iterable, List, Optional, Tuple
 
 from racon_tpu.pipeline.queues import (BoundedQueue, PipelineAborted,
                                        QueueClosed)
+
+#: Stall-detector window, seconds: no stage progressing AND no item
+#: drained for this long converts a silent deadlock into an abort
+#: cascade with a diagnostic dump. 0 disables the detector.
+ENV_STALL = "RACON_TPU_STALL_S"
+_STALL_DEFAULT_S = 300.0
+
+
+def stall_window_s() -> float:
+    txt = os.environ.get(ENV_STALL, "").strip()
+    if not txt:
+        return _STALL_DEFAULT_S
+    try:
+        return float(txt)
+    except ValueError:
+        raise ValueError(
+            f"[racon_tpu::pipeline] invalid {ENV_STALL}={txt!r} "
+            "(expected a number of seconds, 0 to disable)")
 
 
 class StageError(RuntimeError):
@@ -46,6 +66,20 @@ class StageError(RuntimeError):
         super().__init__(
             f"[racon_tpu::pipeline] stage {stage!r} failed: {exc!r}")
         self.stage = stage
+
+
+class PipelineStalled(RuntimeError):
+    """The stall detector fired: every live stage sat silent for a full
+    window while the consumer drained nothing — a deadlock or a wedged
+    body that no per-call deadline covers. ``dump`` carries the
+    per-stage/per-queue diagnostic the detector printed to stderr."""
+
+    def __init__(self, window_s: float, dump: str):
+        super().__init__(
+            f"[racon_tpu::pipeline] no stage progressed for "
+            f"{window_s:g}s — pipeline stalled\n{dump}")
+        self.window_s = window_s
+        self.dump = dump
 
 
 class _Stage(threading.Thread):
@@ -68,6 +102,16 @@ class _Stage(threading.Thread):
         self.stall_in_s = 0.0
         self.stall_out_s = 0.0
         self.items = 0
+        # Heartbeat for the stall detector: monotonic time of the last
+        # loop transition, plus what the stage is doing right now.
+        # Written by this thread only; torn reads are harmless (the
+        # detector re-polls).
+        self.last_progress = time.monotonic()
+        self.state = "init"
+
+    def _beat(self, state: str) -> None:
+        self.last_progress = time.monotonic()
+        self.state = state
 
     def run(self) -> None:
         t_start = time.perf_counter()
@@ -88,29 +132,42 @@ class _Stage(threading.Thread):
             self._publish(t_start)
 
     def _run_source(self) -> None:
+        from racon_tpu.resilience.faults import maybe_fault
         it = iter(self.source())
         while True:
+            self._beat("run")
             t0 = time.perf_counter()
             try:
+                maybe_fault(f"pipe/{self.stage_name}")
                 item = next(it)
             except StopIteration:
                 self.busy_s += time.perf_counter() - t0
                 return
             self.busy_s += time.perf_counter() - t0
+            self._beat("put")
             t1 = time.perf_counter()
             self.outq.put(item)
             self.stall_out_s += time.perf_counter() - t1
             self.items += 1
 
     def _run_worker(self) -> None:
+        from racon_tpu.resilience.faults import maybe_fault
         while True:
+            self._beat("get")
             t0 = time.perf_counter()
             item = self.inq.get()            # QueueClosed ends the loop
             self.stall_in_s += time.perf_counter() - t0
+            self._beat("run")
             t1 = time.perf_counter()
+            # The fault site fires BEFORE the work function, so a
+            # ``hang`` here models a wedged stage body while the item
+            # itself is still unprocessed — the stall detector, not a
+            # call deadline, is the recovery under test.
+            maybe_fault(f"pipe/{self.stage_name}")
             out = self.fn(item)
             self.busy_s += time.perf_counter() - t1
             if self.outq is not None and out is not None:
+                self._beat("put")
                 t2 = time.perf_counter()
                 self.outq.put(out)
                 self.stall_out_s += time.perf_counter() - t2
@@ -138,6 +195,8 @@ class Pipeline:
         self._error: Optional[Tuple[str, BaseException]] = None
         self._error_lock = threading.Lock()
         self._started = False
+        self._last_drain = time.monotonic()
+        self._detector: Optional[_StallDetector] = None
 
     # ----------------------------------------------------------- assembly
 
@@ -180,8 +239,13 @@ class Pipeline:
                 f"[racon_tpu::pipeline] pipeline {self.name!r} already "
                 "started")
         self._started = True
+        self._last_drain = time.monotonic()
         for s in self._stages:
             s.start()
+        window = stall_window_s()
+        if window > 0:
+            self._detector = _StallDetector(self, window)
+            self._detector.start()
         return self
 
     def drain(self, q: BoundedQueue):
@@ -192,12 +256,15 @@ class Pipeline:
                 item = q.get()
             except (QueueClosed, PipelineAborted):
                 break
+            self._last_drain = time.monotonic()
             yield item
         self.raise_if_failed()
 
     def shutdown(self, timeout: float = 30.0) -> None:
         """Abort queues (no-op after a clean drain — every stage already
         exited) and join all stage threads; publishes queue gauges."""
+        if self._detector is not None:
+            self._detector.stop()
         for q in self._queues:
             q.abort()
         for s in self._stages:
@@ -224,3 +291,62 @@ class Pipeline:
     @property
     def alive(self) -> bool:
         return any(s.is_alive() for s in self._stages)
+
+    # ------------------------------------------------------ stall dump
+
+    def _stall_dump(self) -> str:
+        now = time.monotonic()
+        lines = ["stage dump (name alive items busy_s state age_s):"]
+        for s in self._stages:
+            lines.append(
+                f"  {s.stage_name:<10} alive={int(s.is_alive())} "
+                f"items={s.items} busy={s.busy_s:.2f}s "
+                f"state={s.state:<4} "
+                f"age={now - s.last_progress:.1f}s")
+        lines.append("queue dump (name depth/capacity):")
+        for q in self._queues:
+            lines.append(f"  {q.name:<10} {q.depth}/{q.capacity}")
+        return "\n".join(lines)
+
+
+class _StallDetector(threading.Thread):
+    """Converts a silent pipeline deadlock into a fail-fast abort.
+
+    Polls stage heartbeats and the consumer's drain timestamp; when the
+    pipeline has live stages yet NOTHING — no stage loop transition, no
+    drained item — moved for a full window, it dumps per-stage/per-queue
+    state to stderr, records ``pipe_stall_events`` + a ``stall`` span,
+    and fails the pipeline with :class:`PipelineStalled` so the abort
+    cascade unblocks every queue instead of hanging forever.
+    """
+
+    def __init__(self, pipe: Pipeline, window_s: float):
+        super().__init__(name=f"racon-stall-{pipe.name}", daemon=True)
+        self.pipe = pipe
+        self.window_s = window_s
+        self._stop = threading.Event()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def run(self) -> None:
+        poll = min(self.window_s / 4.0, 0.5)
+        while not self._stop.wait(poll):
+            pipe = self.pipe
+            if not pipe.alive:
+                continue
+            now = time.monotonic()
+            newest = max([s.last_progress for s in pipe._stages]
+                         + [pipe._last_drain])
+            if now - newest < self.window_s:
+                continue
+            dump = pipe._stall_dump()
+            print(f"[racon_tpu::pipeline] stall detected: no progress "
+                  f"for {now - newest:.1f}s (window {self.window_s:g}s)"
+                  f"\n{dump}", file=sys.stderr, flush=True)
+            from racon_tpu.obs.metrics import record_stall
+            from racon_tpu.resilience import watchdog
+            record_stall(self.window_s, len(pipe._stages))
+            watchdog.note_stall(len(pipe._stages))
+            pipe._fail("stall", PipelineStalled(self.window_s, dump))
+            return

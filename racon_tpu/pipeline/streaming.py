@@ -43,7 +43,7 @@ import time
 from typing import Dict, Iterator, List, Optional, Tuple
 
 from racon_tpu.pipeline import pipeline_depth
-from racon_tpu.pipeline.stages import Pipeline
+from racon_tpu.pipeline.stages import Pipeline, StageError
 
 
 class _Item:
@@ -263,22 +263,52 @@ def stream_consensus(engine, windows, chunk: int = 8192,
     pipe.stage("compute", compute, q_run, q_done)
 
     t0 = time.perf_counter()
+    last_end = 0
     try:
         with tracer.span("pipeline", "stream_consensus", windows=n,
                          depth=depth, chunk=chunk):
-            with pipe:
-                for item in pipe.drain(q_done):
-                    # Same counter the serial path bumps in
-                    # consensus_windows: active windows only, counted
-                    # after their consensus is applied.
-                    record_windows(len(item.windows))
-                    for _sid, s, e in tracker.retire(item.sid):
+            try:
+                with pipe:
+                    for item in pipe.drain(q_done):
+                        # Same counter the serial path bumps in
+                        # consensus_windows: active windows only,
+                        # counted after their consensus is applied.
+                        record_windows(len(item.windows))
+                        for _sid, s, e in tracker.retire(item.sid):
+                            if tick is not None:
+                                tick()
+                            last_end = e
+                            yield (s, e)
+                    for _sid, s, e in tracker.flush():
                         if tick is not None:
                             tick()
+                        last_end = e
                         yield (s, e)
-                for _sid, s, e in tracker.flush():
+            except StageError as err:
+                from racon_tpu.pipeline.stages import PipelineStalled
+                if not isinstance(err.__cause__, PipelineStalled):
+                    raise
+                # Stall recovery: the abort cascade already tore the
+                # pipeline down (the with-block joined every stage), so
+                # in-flight items are lost — but _consensus_host is
+                # idempotent and bit-identical, so re-polishing every
+                # window past the last retired slice on the host path
+                # preserves the output bytes. The pipe/<stage> hang
+                # fires BEFORE the stage body touches host_lock, so the
+                # lock is free here.
+                active = []
+                for w in windows[last_end:]:
+                    if w.n_layers < 2:
+                        w.set_backbone_consensus()
+                    else:
+                        active.append(w)
+                if active:
+                    with host_lock:
+                        engine._degrade(active, err.__cause__)
+                    record_windows(len(active))
+                if last_end < n:
                     if tick is not None:
                         tick()
-                    yield (s, e)
+                    yield (last_end, n)
     finally:
         record_pipeline_wall(time.perf_counter() - t0)

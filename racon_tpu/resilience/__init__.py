@@ -12,6 +12,11 @@ safety nets as any production training stack:
 - :mod:`racon_tpu.resilience.checkpoint` — contig-granular
   checkpoint/resume keyed by a run fingerprint
   (``--checkpoint-dir`` / ``--resume``).
+- :mod:`racon_tpu.resilience.watchdog` — monotonic-clock deadlines
+  around the same choke points for the *fail-slow* class (a wedged
+  call that never raises), escalating to worker self-eviction at the
+  terminal breach budget (``RACON_TPU_DEADLINE_*`` /
+  ``RACON_TPU_WATCHDOG_TERMINAL``).
 
 docs/RESILIENCE.md is the operator-facing reference.
 """
@@ -25,6 +30,10 @@ from racon_tpu.resilience.faults import (ENV_FAULTS, FaultInjector,
 from racon_tpu.resilience.retry import (ENV_RETRY, RetryExhausted,
                                         RetryPolicy, call as with_retry,
                                         default_policy)
+from racon_tpu.resilience.watchdog import (EXIT_SELF_EVICT,
+                                           DispatchTimeout,
+                                           WatchdogTerminal, guard,
+                                           is_terminal)
 
 __all__ = [
     "CheckpointError", "CheckpointStore", "run_fingerprint",
@@ -32,4 +41,6 @@ __all__ = [
     "maybe_fault",
     "ENV_RETRY", "RetryExhausted", "RetryPolicy", "with_retry",
     "default_policy",
+    "EXIT_SELF_EVICT", "DispatchTimeout", "WatchdogTerminal", "guard",
+    "is_terminal",
 ]

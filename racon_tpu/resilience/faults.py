@@ -19,6 +19,9 @@ Spec grammar (clauses joined by ``;``)::
              | 'kill'                      # hard exit 137, no cleanup
              | 'term' | 'int'             # signal self (SIGTERM/SIGINT)
              | 'torn'                      # tear the write in progress
+             | 'hang' ['=' float]         # sleep past the armed watchdog
+                                          #   deadline (or S seconds)
+             | 'stall' ['=' float]        # sleep S seconds, then proceed
 
 Examples::
 
@@ -73,7 +76,15 @@ from typing import Dict, List, Optional, Tuple
 
 ENV_FAULTS = "RACON_TPU_FAULTS"
 
-_ACTIONS = ("raise", "kill", "term", "int", "torn")
+_ACTIONS = ("raise", "kill", "term", "int", "torn", "hang", "stall")
+
+#: Fallback sleep for ``stall`` with no explicit duration, seconds.
+ENV_STALL_S = "RACON_TPU_FAULT_STALL_S"
+_STALL_DEFAULT_S = 1.0
+#: Fallback sleep for ``hang`` when no watchdog deadline is armed on
+#: the current thread and no explicit duration was given, seconds.
+ENV_HANG_S = "RACON_TPU_FAULT_HANG_S"
+_HANG_DEFAULT_S = 30.0
 
 
 def hard_exit(code: int) -> None:
@@ -105,13 +116,14 @@ class FaultSpecError(ValueError):
 
 
 class _SiteRule:
-    __slots__ = ("indices", "prob", "action")
+    __slots__ = ("indices", "prob", "action", "duration")
 
     def __init__(self, indices: Optional[frozenset], prob: float,
-                 action: str):
+                 action: str, duration: Optional[float] = None):
         self.indices = indices   # frozenset of call indices, or None
         self.prob = prob         # used when indices is None
         self.action = action
+        self.duration = duration  # hang=S / stall=S sleep, seconds
 
 
 def _parse(spec: str) -> Tuple[Dict[str, _SiteRule], int, float]:
@@ -139,8 +151,24 @@ def _parse(spec: str) -> Tuple[Dict[str, _SiteRule], int, float]:
                 "'site:selector' or 'seed=N'")
         site, sel = clause.split(":", 1)
         action = "raise"
+        duration: Optional[float] = None
         if "!" in sel:
             sel, action = sel.split("!", 1)
+            if "=" in action:
+                # hang=S / stall=S: explicit sleep duration, seconds.
+                action, dur_txt = action.split("=", 1)
+                if action not in ("hang", "stall"):
+                    raise FaultSpecError(
+                        f"[racon_tpu::faults] action {action!r} takes "
+                        f"no '=' argument in clause {clause!r}")
+                try:
+                    duration = float(dur_txt)
+                    if duration < 0:
+                        raise ValueError
+                except ValueError:
+                    raise FaultSpecError(
+                        f"[racon_tpu::faults] bad duration {dur_txt!r} "
+                        f"in clause {clause!r}")
             if action not in _ACTIONS:
                 raise FaultSpecError(
                     f"[racon_tpu::faults] unknown action {action!r} "
@@ -154,12 +182,12 @@ def _parse(spec: str) -> Tuple[Dict[str, _SiteRule], int, float]:
                 prob = float(sel[2:])
                 if not 0.0 <= prob <= 1.0:
                     raise ValueError
-                rules[site] = _SiteRule(None, prob, action)
+                rules[site] = _SiteRule(None, prob, action, duration)
             else:
                 idx = frozenset(int(p) for p in sel.split(","))
                 if any(i < 0 for i in idx):
                     raise ValueError
-                rules[site] = _SiteRule(idx, 0.0, action)
+                rules[site] = _SiteRule(idx, 0.0, action, duration)
         except ValueError:
             raise FaultSpecError(
                 f"[racon_tpu::faults] bad selector {sel!r} in clause "
@@ -206,10 +234,14 @@ class FaultInjector:
             action = self._decide(site, index)
             if action is not None:
                 self.fired.append((site, index, action))
+                duration = self._rules[site].duration
         if action is None:
             return False
         from racon_tpu.obs.metrics import record_fault
         record_fault(site, index, action)
+        if action in ("hang", "stall"):
+            self._sleep(action, duration)
+            return False
         if action == "torn" and torn_ok:
             return True
         if action in ("raise", "torn"):
@@ -221,6 +253,34 @@ class FaultInjector:
         os.kill(os.getpid(), signal.SIGTERM if action == "term"
                 else signal.SIGINT)
         return False
+
+    @staticmethod
+    def _sleep(action: str, duration: Optional[float]) -> None:
+        """Fail-slow actions: block, then PROCEED normally.
+
+        ``stall`` sleeps a bounded duration (explicit ``=S`` or
+        RACON_TPU_FAULT_STALL_S, default 1s) — a transient slowdown
+        that must NOT trip anything by itself. ``hang`` sleeps provably
+        past whatever watchdog deadline is armed on the current thread
+        (2x the ambient deadline), falling back to an explicit ``=S``
+        or RACON_TPU_FAULT_HANG_S (default 30s) when unguarded — e.g.
+        at a pipeline-stage site, where the stall *detector*, not a
+        call deadline, is the recovery under test. Returning (rather
+        than sleeping forever) lets abandoned guard threads terminate
+        deterministically, so tests never leak busy threads."""
+        import time as _time
+        if action == "stall":
+            if duration is None:
+                duration = float(os.environ.get(ENV_STALL_S, "") or
+                                 _STALL_DEFAULT_S)
+            _time.sleep(duration)
+            return
+        if duration is None:
+            from racon_tpu.resilience.watchdog import ambient_deadline
+            armed = ambient_deadline()
+            duration = 2.0 * armed if armed > 0 else \
+                float(os.environ.get(ENV_HANG_S, "") or _HANG_DEFAULT_S)
+        _time.sleep(duration)
 
     def counts(self) -> Dict[str, int]:
         with self._lock:

@@ -163,24 +163,44 @@ def configure(policy: Optional[RetryPolicy]) -> None:
 
 
 def call(site: str, fn: Callable, *args,
-         policy: Optional[RetryPolicy] = None, **kwargs):
+         policy: Optional[RetryPolicy] = None,
+         deadline_s: Optional[float] = None, **kwargs):
     """Run ``fn(*args, **kwargs)`` under the retry policy, with the
     fault injector's hook for ``site`` armed before every try.
 
     The injection point sits INSIDE the retried body, so a fault spec
     like ``h2d/chunk:0,1`` exercises the real recovery path: try 1 and
     2 raise, try 3 (call index 2 at that site) succeeds.
+
+    Every attempt additionally runs under the fail-slow watchdog
+    (resilience/watchdog.py): ``deadline_s=None`` resolves the site's
+    geometry-free class default, callers with chunk geometry in hand
+    pass a derived deadline, and <= 0 disables the guard. A breach
+    raises DispatchTimeout — a TimeoutError, so it is transient and
+    lands in this very retry loop; crossing the terminal breach budget
+    raises WatchdogTerminal, which is NOT transient and propagates.
+    The fault hook sits inside the guarded body so an injected ``hang``
+    is bounded by the same deadline as an organic one.
     """
     from racon_tpu.obs.metrics import (record_retry,
                                        record_retry_exhausted)
     from racon_tpu.resilience.faults import maybe_fault
+    from racon_tpu.resilience.watchdog import guard, site_deadline
+
+    if deadline_s is None:
+        deadline_s = site_deadline(site)
+
+    def _attempt():
+        maybe_fault(site)
+        return fn(*args, **kwargs)
 
     pol = policy if policy is not None else default_policy()
     attempt = 0
     while True:
         try:
-            maybe_fault(site)
-            return fn(*args, **kwargs)
+            if deadline_s and deadline_s > 0:
+                return guard(site, deadline_s, _attempt)
+            return _attempt()
         except BaseException as exc:  # noqa: BLE001 — filtered below
             if not pol.retryable(exc):
                 raise

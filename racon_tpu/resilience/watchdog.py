@@ -1,0 +1,324 @@
+"""Monotonic-clock deadline watchdog for the fail-slow failure class.
+
+The retry ladder (retry.py) only fires when a choke point *raises*; a
+wedged dispatch — a tunnel socket that neither delivers nor errors, an
+XLA executable that never returns — hangs the process forever, and in a
+ledger fleet the wedged worker keeps renewing nothing while its lease
+stays unstealable until the term runs out. :func:`guard` converts that
+silence into an exception within a bounded deadline:
+
+- the guarded body runs in a reusable daemon worker thread; the caller
+  waits at most ``deadline_s`` on the monotonic clock;
+- a breach raises :class:`DispatchTimeout` (a ``TimeoutError``, so
+  retry.call's transient filter accepts it unchanged) into the existing
+  retry → redo → degrade ladder: a slow dispatch is retried, then
+  host-degraded, never waited on forever;
+- when ``RACON_TPU_WATCHDOG_TERMINAL=N`` (default 0 = never) is set and
+  the process-wide breach count reaches N, the breach raises
+  :class:`WatchdogTerminal` instead — non-transient, so it propagates
+  to the worker loop, which releases its ledger lease (an explicit
+  ``release`` event — thieves do not wait out the lease term), flushes
+  a final obs snapshot, and exits :data:`EXIT_SELF_EVICT`.
+
+Per-site deadlines derive from chunk geometry in ops/budget.py
+(``transfer_deadline_s`` / ``dispatch_deadline_s``, env-tunable via
+``RACON_TPU_DEADLINE_*``); :func:`site_deadline` supplies the
+geometry-free class default for sites that pass none. A deadline of 0
+disables the guard (the body runs inline on the caller thread).
+
+The abandoned worker thread keeps running its wedged body (there is no
+safe cross-thread kill in CPython); it is daemonic, flagged so it
+retires instead of rejoining the free pool, and the process never waits
+on it — which is exactly the property the injected ``hang`` fault
+action (faults.py) proves on CPU: the sleep outlives the deadline, the
+caller does not.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+ENV_TERMINAL = "RACON_TPU_WATCHDOG_TERMINAL"
+
+#: Exit code of a worker that self-evicted on a terminal watchdog
+#: breach (EX_TEMPFAIL: the shard is fine, this host is not — retry
+#: elsewhere). Distinct from 130/143 (signals) and 137 (hard kill).
+EXIT_SELF_EVICT = 75
+
+
+class DispatchTimeout(TimeoutError):
+    """A guarded call site exceeded its deadline.
+
+    Subclasses ``TimeoutError`` so retry.py's transient filter treats a
+    breach exactly like a tunnel timeout: retried, then degraded.
+    """
+
+    def __init__(self, site: str, deadline_s: float, waited_s: float):
+        super().__init__(
+            f"[racon_tpu::watchdog] {site} exceeded its {deadline_s:.3f}s "
+            f"deadline (waited {waited_s:.3f}s); the call keeps running "
+            "on an abandoned thread")
+        self.site = site
+        self.deadline_s = deadline_s
+        self.waited_s = waited_s
+
+
+class WatchdogTerminal(RuntimeError):
+    """The process crossed its terminal breach budget — this host is
+    considered wedged. Deliberately NOT transient: it must reach the
+    worker loop (self-eviction) or the CLI (exit 75), not the retry
+    loop."""
+
+    def __init__(self, site: str, breaches: int, limit: int):
+        super().__init__(
+            f"[racon_tpu::watchdog] terminal: {breaches} deadline "
+            f"breach(es) (limit {limit}, last at {site}) — this worker "
+            "is wedged and should hand its work back")
+        self.site = site
+        self.breaches = breaches
+        self.limit = limit
+
+
+def terminal_limit() -> int:
+    """Breach count at which a breach becomes terminal; 0 disables."""
+    txt = os.environ.get(ENV_TERMINAL, "")
+    if not txt:
+        return 0
+    try:
+        v = int(txt)
+    except ValueError:
+        raise ValueError(
+            f"[racon_tpu::watchdog] invalid {ENV_TERMINAL}={txt!r} "
+            "(expected an integer breach count, 0 to disable)")
+    if v < 0:
+        raise ValueError(
+            f"[racon_tpu::watchdog] invalid {ENV_TERMINAL}={v} "
+            "(must be >= 0)")
+    return v
+
+
+def is_terminal(exc: BaseException) -> bool:
+    """True when ``exc`` is (or was caused by, at any chain depth) a
+    :class:`WatchdogTerminal` — a pipeline stage wraps it in StageError,
+    so the worker loop checks the cause chain, not the type."""
+    seen = set()
+    cur: Optional[BaseException] = exc
+    while cur is not None and id(cur) not in seen:
+        if isinstance(cur, WatchdogTerminal):
+            return True
+        seen.add(id(cur))
+        cur = cur.__cause__ or cur.__context__
+    return False
+
+
+# ----------------------------------------------------------- guard pool
+
+class _Job:
+    __slots__ = ("fn", "args", "kwargs", "done", "result", "exc",
+                 "stack", "deadline_s")
+
+    def __init__(self, fn, args, kwargs, stack, deadline_s):
+        self.fn = fn
+        self.args = args
+        self.kwargs = kwargs
+        self.done = threading.Event()
+        self.result = None
+        self.exc: Optional[BaseException] = None
+        self.stack = stack          # caller's tracer span stack (copy)
+        self.deadline_s = deadline_s
+
+
+class _GuardWorker(threading.Thread):
+    """One reusable guard thread: jobs arrive via a condition variable,
+    results ride on the job object (never on the worker, so a late
+    result from an abandoned job cannot be confused with a new one)."""
+
+    def __init__(self):
+        super().__init__(name="racon-watchdog", daemon=True)
+        self.cv = threading.Condition()
+        self.job: Optional[_Job] = None
+        self.abandoned = False
+        self.start()
+
+    def submit(self, job: _Job) -> None:
+        with self.cv:
+            self.job = job
+            self.cv.notify()
+
+    def run(self) -> None:
+        from racon_tpu.obs.trace import get_tracer
+        while True:
+            with self.cv:
+                while self.job is None:
+                    self.cv.wait()
+                job = self.job
+            tracer = get_tracer()
+            # Bridge the caller's span stack (a COPY — an abandoned
+            # worker finishing late must not corrupt the caller's) so
+            # spans emitted inside the guarded body keep their parents.
+            tracer.install_stack(job.stack)
+            _local.deadline = job.deadline_s
+            try:
+                job.result = job.fn(*job.args, **job.kwargs)
+            except BaseException as exc:  # noqa: BLE001 — re-raised by guard()
+                job.exc = exc
+            finally:
+                _local.deadline = 0.0
+                tracer.install_stack([])
+            with self.cv:
+                self.job = None
+                retire = self.abandoned
+            job.done.set()
+            if retire:
+                return              # never rejoin the pool
+            with _pool_lock:
+                _pool.append(self)
+
+
+_pool_lock = threading.Lock()
+_pool: List[_GuardWorker] = []
+_local = threading.local()
+
+_state_lock = threading.Lock()
+_breaches: Dict[str, int] = {}
+_breach_total = 0
+_terminal_total = 0
+_last_breach: Optional[Dict[str, object]] = None
+_stall_total = 0
+
+
+def ambient_deadline() -> float:
+    """The deadline armed on the CURRENT thread (a guarded body sees its
+    own deadline; everything else sees 0). The ``hang`` fault action
+    uses this to sleep provably past whatever deadline is watching."""
+    return getattr(_local, "deadline", 0.0)
+
+
+def site_deadline(site: str) -> float:
+    """Geometry-free class default for a retry site, by prefix. Sites
+    outside the transfer/dispatch families get no deadline (0)."""
+    from racon_tpu.ops.budget import (dispatch_deadline_s,
+                                      transfer_deadline_s)
+    if site.startswith("h2d/"):
+        return transfer_deadline_s(0, "h2d")
+    if site.startswith("d2h/"):
+        return transfer_deadline_s(0, "d2h")
+    if site.startswith(("dispatch/", "sched/")):
+        # Flag pulls sync on compute, so they share the dispatch budget.
+        return dispatch_deadline_s(0)
+    return 0.0
+
+
+def _checkout() -> _GuardWorker:
+    with _pool_lock:
+        if _pool:
+            return _pool.pop()
+    return _GuardWorker()
+
+
+def _record_breach(site: str, deadline_s: float, waited_s: float,
+                   terminal: bool) -> int:
+    global _breach_total, _terminal_total, _last_breach
+    with _state_lock:
+        _breach_total += 1
+        _breaches[site] = _breaches.get(site, 0) + 1
+        if terminal:
+            _terminal_total += 1
+        _last_breach = {"site": site, "deadline_s": deadline_s,
+                        "waited_s": round(waited_s, 3),
+                        "unix_time": time.time()}
+        total = _breach_total
+    from racon_tpu.obs.metrics import record_watchdog_breach
+    record_watchdog_breach(site, deadline_s, waited_s, terminal=terminal)
+    return total
+
+
+def guard(site: str, deadline_s: Optional[float], fn: Callable,
+          *args, **kwargs):
+    """Run ``fn(*args, **kwargs)`` under a monotonic deadline.
+
+    ``deadline_s=None`` resolves :func:`site_deadline`; a resolved
+    deadline <= 0 runs the body inline (guard disabled). On a breach the
+    worker thread is abandoned (flagged to retire, never reused) and
+    :class:`DispatchTimeout` — or :class:`WatchdogTerminal` once the
+    process-wide breach count reaches ``RACON_TPU_WATCHDOG_TERMINAL`` —
+    is raised on the caller.
+    """
+    if deadline_s is None:
+        deadline_s = site_deadline(site)
+    if not deadline_s or deadline_s <= 0:
+        return fn(*args, **kwargs)
+    from racon_tpu.obs.trace import get_tracer
+    job = _Job(fn, args, kwargs, get_tracer().snapshot_stack(),
+               float(deadline_s))
+    worker = _checkout()
+    t0 = time.monotonic()
+    worker.submit(job)
+    if not job.done.wait(deadline_s):
+        waited = time.monotonic() - t0
+        completed = False
+        with worker.cv:
+            if worker.job is job:
+                worker.abandoned = True     # retires after the late job
+            else:
+                completed = True            # finished a hair past deadline
+        if not completed:
+            limit = terminal_limit()
+            # Peek whether THIS breach crosses the limit before
+            # recording, so the terminal flag lands on the right record.
+            with _state_lock:
+                will_be = _breach_total + 1
+            terminal = bool(limit) and will_be >= limit
+            total = _record_breach(site, deadline_s, waited, terminal)
+            if terminal:
+                raise WatchdogTerminal(site, total, limit)
+            raise DispatchTimeout(site, deadline_s, waited)
+        job.done.wait()
+    if job.exc is not None:
+        raise job.exc
+    return job.result
+
+
+# -------------------------------------------------------------- health
+
+def note_stall(n_stages: int) -> None:
+    """Pipeline stall detector callback — folds stall state into
+    :func:`health_snapshot`."""
+    global _stall_total
+    with _state_lock:
+        _stall_total += 1
+
+
+def health_snapshot() -> Dict[str, object]:
+    """Liveness view for the ``/healthz`` endpoint: ``status`` is
+    ``"ok"`` until a terminal breach or a pipeline stall has been seen
+    (the conditions under which an operator should reschedule this
+    worker); breach counters ride along for dashboards."""
+    with _state_lock:
+        status = "ok"
+        if _terminal_total:
+            status = "terminal"
+        elif _stall_total:
+            status = "stalled"
+        return {
+            "status": status,
+            "watchdog_breaches": _breach_total,
+            "watchdog_terminal": _terminal_total,
+            "pipeline_stalls": _stall_total,
+            "breaches_by_site": dict(_breaches),
+            "last_breach": dict(_last_breach) if _last_breach else None,
+        }
+
+
+def reset() -> None:
+    """Clear process-wide breach/stall state (test isolation hook)."""
+    global _breach_total, _terminal_total, _last_breach, _stall_total
+    with _state_lock:
+        _breaches.clear()
+        _breach_total = 0
+        _terminal_total = 0
+        _last_breach = None
+        _stall_total = 0

@@ -260,8 +260,13 @@ class ConvergenceScheduler:
                            time.perf_counter() - t_put, name="h2d/repack")
                 return lane_idx_d, new_win_d, win_map_d, win_real_d
 
+            from racon_tpu.ops.budget import transfer_deadline_s
             lane_idx_d, new_win_d, win_map_d, win_real_d = \
-                retry_call("h2d/repack", _put_repack)
+                retry_call("h2d/repack", _put_repack,
+                           deadline_s=transfer_deadline_s(
+                               rp.lane_idx.nbytes + rp.new_win.nbytes +
+                               rp.win_map.nbytes + rp.win_real.nbytes,
+                               "h2d"))
             with tracer.span("dispatch", "repack", lanes=rp.B,
                              windows=n_alive):
                 (bb, bbw, alen, begin, end, q, qw8, lq, w_read, ovf) = \

@@ -58,6 +58,48 @@ def atomic_write_text(path: str, text: str,
     atomic_write_bytes(path, text.encode(encoding))
 
 
+class atomic_writer:
+    """Context manager for STREAMING an atomic write: yields a binary
+    file handle on ``<path>.tmp.<pid>``; a clean exit fsyncs and renames
+    onto ``path``, any exception unlinks the tmp file and re-raises —
+    readers of ``path`` see old-or-new, never a partial, even when the
+    writer dies mid-stream (a crash leaves only the orphaned tmp, which
+    a rerun under the same pid namespace simply overwrites).
+
+    :func:`atomic_write_bytes` remains the one-shot form; this is for
+    producers whose payload is too large or too incremental to buffer
+    (the distributed ledger's merged FASTA).
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self.tmp = f"{path}.tmp.{os.getpid()}"
+        self._fh = None
+
+    def __enter__(self):
+        self._fh = open(self.tmp, "wb")
+        return self._fh
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        fh = self._fh
+        self._fh = None
+        if exc_type is not None:
+            try:
+                fh.close()
+            finally:
+                try:
+                    os.remove(self.tmp)
+                except OSError:
+                    pass
+            return False
+        fh.flush()
+        os.fsync(fh.fileno())
+        fh.close()
+        os.replace(self.tmp, self.path)
+        fsync_dir(os.path.dirname(os.path.abspath(self.path)))
+        return False
+
+
 def atomic_finalize(tmp_path: str, final_path: str) -> None:
     """Promote an already-written (and closed) tmp file to its final
     name atomically. The caller is responsible for having fsync'd the

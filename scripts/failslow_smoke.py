@@ -1,0 +1,270 @@
+"""CI smoke: fail-slow hardening, end to end through real processes
+(racon_tpu/resilience/watchdog.py, docs/RESILIENCE.md "Fail-slow").
+
+The drill, against a serial baseline:
+
+1. **Choke-point hangs** — one serial run per device choke point
+   (h2d/chunk, dispatch/chunk, d2h/chunk) with an injected ``hang``
+   (sleeps past 2x the ambient deadline) and a ~3 s deadline base: the
+   watchdog must convert each silent wedge into DispatchTimeout inside
+   the retry ladder, the run must finish byte-identical, and the trace
+   footer must count the breach.
+2. **Pipeline stage hang** — streaming pipeline with a wedged ``pack``
+   stage body and a 2 s stall window: the stall detector fires, dumps
+   stage/queue state to stderr, and the driver re-polishes the tail on
+   the host — byte-identical output, ``pipe_stall_events`` counted.
+3. **Fleet self-eviction** — a 2-worker ledger fleet where worker A
+   hangs at dispatch under ``RACON_TPU_WATCHDOG_TERMINAL=1``: A must
+   exit EXIT_SELF_EVICT (75) well before the 60 s hang expires, leave
+   an explicit ``release`` event in events.jsonl (thieves do not wait
+   out the lease term), and worker B must claim, polish, and merge
+   byte-identically to serial.
+4. **Merge drill** — a worker SIGTERMed mid-merge-write
+   (``dist/merge_write:1!term``) must leave NO out.fasta (the atomic
+   writer unlinks its tmp); a successor steals the merge lease and
+   re-merges byte-identically.
+
+Zero hung processes: every subprocess is reaped with a bounded
+communicate() — a wait-out anywhere fails the smoke by timeout.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np                                   # noqa: E402
+
+BASES = np.frombuffer(b"ACGT", np.uint8)
+BOOT = "import sys; from racon_tpu import cli; sys.exit(cli.main(sys.argv[1:]))"
+N_CONTIGS = 4
+N_SHARDS = 2
+EXIT_SELF_EVICT = 75
+
+#: Env this smoke (or an operator shell) might set — scrubbed per run.
+_SCRUB = (
+    "RACON_TPU_FAULTS", "RACON_TPU_TRACE", "RACON_TPU_PIPELINE",
+    "RACON_TPU_STALL_S", "RACON_TPU_WATCHDOG_TERMINAL",
+    "RACON_TPU_DEADLINE_H2D", "RACON_TPU_DEADLINE_D2H",
+    "RACON_TPU_DEADLINE_DISPATCH", "RACON_TPU_DEADLINE_MBPS",
+    "RACON_TPU_DEADLINE_CELLS_PER_S", "RACON_TPU_DEADLINE_SCALE",
+    "RACON_TPU_FAULT_HANG_S", "RACON_TPU_FAULT_STALL_S",
+    "RACON_TPU_SCHED",
+)
+
+#: The convergence scheduler replaces the fused all-rounds dispatch
+#: with its own sched/flags + h2d/repack sites, so the dispatch/chunk
+#: choke point only exists on the fixed-round path.
+_SITE_ENV = {"dispatch/chunk": {"RACON_TPU_SCHED": "0"}}
+
+
+def _noisy(rng, truth):
+    out = []
+    for b in truth:
+        r = rng.random()
+        if r < 0.03:
+            continue
+        out.append(int(rng.integers(0, 4)) if r < 0.06 else int(
+            np.searchsorted(BASES, b)))
+    return bytes(BASES[np.array(out)])
+
+
+def _write_inputs(d):
+    rng = np.random.default_rng(11)
+    drafts, reads, paf = [], [], []
+    for c in range(N_CONTIGS):
+        truth = BASES[rng.integers(0, 4, 300 + 30 * c)]
+        draft = _noisy(rng, truth)
+        drafts.append(b">c%d\n%s\n" % (c, draft))
+        for i in range(6):
+            r = _noisy(rng, truth)
+            rid = f"r{c}_{i}"
+            reads.append(b">%s\n%s\n" % (rid.encode(), r))
+            paf.append(f"{rid}\t{len(r)}\t0\t{len(r)}\t+\tc{c}"
+                       f"\t{len(draft)}\t0\t{len(draft)}"
+                       f"\t{min(len(r), len(draft))}"
+                       f"\t{max(len(r), len(draft))}\t60")
+    with open(os.path.join(d, "draft.fasta"), "wb") as fh:
+        fh.write(b"".join(drafts))
+    with open(os.path.join(d, "reads.fasta"), "wb") as fh:
+        fh.write(b"".join(reads))
+    with open(os.path.join(d, "ovl.paf"), "w") as fh:
+        fh.write("\n".join(paf) + "\n")
+
+
+def _cmd(d, *extra):
+    return [sys.executable, "-c", BOOT, "--backend", "jax", *extra,
+            os.path.join(d, "reads.fasta"), os.path.join(d, "ovl.paf"),
+            os.path.join(d, "draft.fasta")]
+
+
+def _env(**overrides):
+    e = dict(os.environ)
+    for k in _SCRUB:
+        e.pop(k, None)
+    e["RACON_TPU_DIST_SHARDS"] = str(N_SHARDS)
+    e.update(overrides)
+    return e
+
+
+def _metrics_footer(trace_path):
+    with open(trace_path) as fh:
+        for line in fh:
+            rec = json.loads(line)
+            if rec.get("ev") == "metrics":
+                return rec
+    raise AssertionError(f"no metrics footer in {trace_path}")
+
+
+def _check_trace(trace, want_kind, want_render):
+    import io
+
+    from scripts import obs_report
+    tr = obs_report.load_trace(trace)
+    errs = obs_report.validate(tr)
+    assert not errs, "trace schema violations:\n" + "\n".join(errs)
+    assert want_kind in {s["kind"] for s in tr["spans"].values()}, \
+        f"no {want_kind!r} span in {trace}"
+    buf = io.StringIO()
+    obs_report.render(tr, out=buf)
+    assert want_render in buf.getvalue(), buf.getvalue()
+
+
+def main():
+    with tempfile.TemporaryDirectory() as d:
+        _write_inputs(d)
+
+        # Serial baseline: the bytes every hardened run must match.
+        proc = subprocess.run(_cmd(d), capture_output=True, env=_env())
+        assert proc.returncode == 0, proc.stderr.decode()
+        base = proc.stdout
+        assert base.count(b">") == N_CONTIGS
+
+        # ---- 1. a hang at each device choke point, watchdogged.
+        for site in ("h2d/chunk", "dispatch/chunk", "d2h/chunk"):
+            trace = os.path.join(d, site.replace("/", "_") + ".jsonl")
+            t0 = time.monotonic()
+            proc = subprocess.run(
+                _cmd(d), capture_output=True, timeout=300,
+                env=_env(**{
+                    # Bare !hang sleeps 2x whatever deadline is armed.
+                    "RACON_TPU_FAULTS": f"{site}:0!hang",
+                    "RACON_TPU_DEADLINE_H2D": "3",
+                    "RACON_TPU_DEADLINE_D2H": "3",
+                    "RACON_TPU_DEADLINE_DISPATCH": "3",
+                    "RACON_TPU_TRACE": trace,
+                    **_SITE_ENV.get(site, {}),
+                }))
+            wall = time.monotonic() - t0
+            assert proc.returncode == 0, \
+                f"{site}: rc {proc.returncode}: {proc.stderr.decode()}"
+            assert proc.stdout == base, \
+                f"{site}: output diverged after watchdog recovery"
+            m = _metrics_footer(trace)
+            assert m.get("res_watchdog_breach_total", 0) >= 1, m
+            _check_trace(trace, "watchdog", "watchdog: breaches=")
+            print(f"[failslow-smoke] {site}: hang detected in "
+                  f"{wall:.1f}s wall, retried, byte-identical "
+                  f"({int(m['res_watchdog_breach_total'])} breach)",
+                  flush=True)
+
+        # ---- 2. a wedged pipeline stage body, stall-detected.
+        trace = os.path.join(d, "stall.jsonl")
+        proc = subprocess.run(
+            _cmd(d), capture_output=True, timeout=300,
+            env=_env(**{
+                "RACON_TPU_PIPELINE": "1",
+                "RACON_TPU_STALL_S": "2",
+                "RACON_TPU_FAULTS": "pipe/pack:0!hang=8",
+                "RACON_TPU_TRACE": trace,
+            }))
+        assert proc.returncode == 0, proc.stderr.decode()
+        assert proc.stdout == base, "stall recovery diverged"
+        assert b"stall detected" in proc.stderr, proc.stderr.decode()
+        m = _metrics_footer(trace)
+        assert m.get("pipe_stall_events", 0) >= 1, m
+        _check_trace(trace, "stall", "stalls: 1 detector firing")
+        print("[failslow-smoke] pipeline: pack stage wedged, stall "
+              "detector fired at 2s window, host re-polish "
+              "byte-identical", flush=True)
+
+        # ---- 3. 2-worker fleet; A hangs terminally and self-evicts.
+        ledger = os.path.join(d, "ledger")
+        t0 = time.monotonic()
+        a = subprocess.Popen(
+            _cmd(d, "--ledger-dir", ledger, "--workers", "2",
+                 "--worker-id", "A"),
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            env=_env(**{
+                "RACON_TPU_FAULTS": "dispatch/chunk:0!hang=60",
+                "RACON_TPU_DEADLINE_DISPATCH": "3",
+                "RACON_TPU_WATCHDOG_TERMINAL": "1",
+                **_SITE_ENV["dispatch/chunk"],
+            }))
+        a_out, a_err = a.communicate(timeout=300)
+        a_wall = time.monotonic() - t0
+        assert a.returncode == EXIT_SELF_EVICT, \
+            f"A: expected {EXIT_SELF_EVICT}, got {a.returncode}: " \
+            + a_err.decode()
+        assert a_out == b"", "self-evicted worker must not emit output"
+        assert b"self-evicting" in a_err, a_err.decode()
+        assert a_wall < 60, \
+            f"A took {a_wall:.0f}s — waited out the injected hang"
+        events = open(os.path.join(ledger, "events.jsonl"),
+                      "rb").read().decode()
+        assert '"release"' in events, \
+            "no explicit lease release in events.jsonl:\n" + events
+
+        b_trace = os.path.join(d, "b.jsonl")
+        b = subprocess.Popen(
+            _cmd(d, "--ledger-dir", ledger, "--workers", "2",
+                 "--worker-id", "B"),
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            env=_env(RACON_TPU_TRACE=b_trace))
+        b_out, b_err = b.communicate(timeout=300)
+        assert b.returncode == 0, b_err.decode()
+        assert b_out == base, \
+            "fleet merge differs from single-process serial run"
+        assert open(os.path.join(ledger, "out.fasta"),
+                    "rb").read() == base
+        m = _metrics_footer(b_trace)
+        assert m.get("dist_merges", 0) == 1, m
+        print(f"[failslow-smoke] fleet: A self-evicted (exit 75, "
+              f"{a_wall:.1f}s wall, lease released), B polished and "
+              "merged byte-identical to serial", flush=True)
+
+        # ---- 4. SIGTERM mid-merge-write: no partial out.fasta, the
+        # successor re-merges byte-identically.
+        ledger2 = os.path.join(d, "ledger2")
+        w1 = subprocess.run(
+            _cmd(d, "--ledger-dir", ledger2, "--workers", "1",
+                 "--worker-id", "W1"),
+            capture_output=True, timeout=300,
+            env=_env(RACON_TPU_FAULTS="dist/merge_write:1!term"))
+        assert w1.returncode == 143, \
+            f"W1: expected 143, got {w1.returncode}: " \
+            + w1.stderr.decode()
+        assert not os.path.exists(os.path.join(ledger2, "out.fasta")), \
+            "merge victim left a partial out.fasta"
+        w2 = subprocess.run(
+            _cmd(d, "--ledger-dir", ledger2, "--workers", "1",
+                 "--worker-id", "W2"),
+            capture_output=True, timeout=300,
+            env=_env(RACON_TPU_FAULTS="skew=9999"))
+        assert w2.returncode == 0, w2.stderr.decode()
+        assert w2.stdout == base, "re-merge diverged"
+        assert open(os.path.join(ledger2, "out.fasta"),
+                    "rb").read() == base
+        print("[failslow-smoke] merge drill: SIGTERM mid-write left no "
+              "partial output; successor re-merged byte-identical",
+              flush=True)
+
+    print("[failslow-smoke] PASS", flush=True)
+
+
+if __name__ == "__main__":
+    main()

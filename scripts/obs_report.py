@@ -49,6 +49,12 @@ KIND_REQUIRED_ATTRS = {
     # One distributed-ledger event (claim/steal/renew/commit/merge,
     # racon_tpu/distributed/): which shard, and which worker did it.
     "dist": ("shard", "worker"),
+    # One watchdog deadline breach (resilience/watchdog.py): how long
+    # the site was allowed and how long it actually waited.
+    "watchdog": ("deadline_s", "waited_s"),
+    # One pipeline stall-detector firing (pipeline/stages.py): the
+    # silence window that tripped it and how many stages were frozen.
+    "stall": ("window_s", "stages"),
 }
 
 # Span intervals are rounded to 1e-6 on write and a parent's clock stops
@@ -286,6 +292,11 @@ def _render_pipeline(m, out) -> None:
     if eff is not None:
         print(f"overlap efficiency: {float(eff):.3f} "
               "(compute busy / pipeline wall)", file=out)
+    stalls = int(m.get("pipe_stall_events", 0) or 0)
+    if stalls:
+        print(f"stalls: {stalls} detector firing(s) — frozen stages "
+              "were dumped to stderr and re-polished on the host",
+              file=out)
 
 
 def _render_resilience(m, by_kind, out) -> None:
@@ -313,6 +324,18 @@ def _render_resilience(m, by_kind, out) -> None:
                   f"{int(res.get(f'res_retry_site_{site}', 0)):>7}  "
                   f"{int(res.get(f'res_fault_site_{site}', 0)):>6}",
                   file=out)
+    breaches = int(m.get("res_watchdog_breach_total", 0))
+    if breaches:
+        wsites = sorted(k[len("res_watchdog_site_"):] for k in res
+                        if k.startswith("res_watchdog_site_"))
+        per = "  ".join(
+            f"{s}={int(res[f'res_watchdog_site_{s}'])}" for s in wsites)
+        print(f"watchdog: breaches={breaches}  "
+              f"terminal={int(m.get('res_watchdog_terminal_total', 0))}"
+              f"  stalls={int(m.get('pipe_stall_events', 0))}",
+              file=out)
+        if per:
+            print(f"  breach sites: {per}", file=out)
     backoff = float(m.get("res_retry_backoff_s", 0.0))
     if backoff:
         print(f"backoff slept: {backoff:.3f}s", file=out)
@@ -345,6 +368,12 @@ def _render_dist(m, by_kind, out) -> None:
           f"  resumed={int(m.get('dist_contigs_resumed', 0))}  "
           f"repolished={int(m.get('dist_contigs_repolished', 0))}",
           file=out)
+    rels = int(m.get("dist_releases", 0))
+    evics = int(m.get("dist_self_evictions", 0))
+    if rels or evics:
+        print(f"  releases={rels}  self_evictions={evics}  "
+              "(fail-slow: lease given back before the term expired)",
+              file=out)
     lat = float(m.get("dist_steal_latency_s", 0.0))
     rec = float(m.get("dist_recovery_wall_s", 0.0))
     if lat or rec:
@@ -363,8 +392,9 @@ def _render_dist(m, by_kind, out) -> None:
 def _render_fleet(fleet_dir: str, out) -> None:
     """The "Fleet" section (``--fleet LEDGER_DIR``): the cross-worker
     view from the worker metric shards + events.jsonl — per-worker
-    rates, merged counters, and the per-shard lease timeline
-    (claim/renew/steal/complete, renew runs compressed). Mixed-run
+    rates (stragglers flagged), merged counters, and the per-shard
+    lease timeline (claim/renew/steal/release/complete, renew runs
+    compressed). Mixed-run
     shard directories raise FleetObsError in the aggregator; main()
     turns that into a clear exit-1 error."""
     import os
@@ -380,10 +410,12 @@ def _render_fleet(fleet_dir: str, out) -> None:
     for wid in sorted(model["workers"]):
         w = model["workers"][wid]
         seq = w.get("seq")
+        flag = "  STRAGGLER" if w.get("straggler") else ""
         print(f"  {wid:>16}  {w['windows_per_sec']:>9.1f}  "
               f"{w['wall_s']:>8.2f}  "
               f"{'yes' if w['final'] else 'no':>5}  "
-              f"{(seq + 1 if isinstance(seq, int) else '?'):>9}",
+              f"{(seq + 1 if isinstance(seq, int) else '?'):>9}"
+              f"{flag}",
               file=out)
         phases = w.get("phase_seconds", {})
         if phases:
@@ -391,6 +423,11 @@ def _render_fleet(fleet_dir: str, out) -> None:
             line = "  ".join(f"{name}={secs:.2f}s"
                              for name, secs in top)
             print(f"  {'':>16}  phases: {line}", file=out)
+    stragglers = model.get("stragglers") or []
+    if stragglers:
+        print("  stragglers: " + ", ".join(stragglers) +
+              "  (windows/s below the fleet-median fraction, "
+              "obs/fleet.py)", file=out)
     timeline = model.get("timeline", {})
     if timeline:
         print("  lease timeline:", file=out)

@@ -195,6 +195,74 @@ def test_extension_dispatch_errors(tmp_path):
         create_overlap_parser(str(bad))
 
 
+# -------------------- truncated streams / injected read failures -------------
+
+
+def _truncated_gz(path, payload):
+    blob = gzip.compress(payload)
+    path.write_bytes(blob[:len(blob) // 2])  # cut the member mid-stream
+
+
+def _big_payload(n_records=6000):
+    # Larger than one _block_lines read (4 MB decompressed) so the
+    # parser makes real progress before the stream breaks and the
+    # reported offset proves the high-water tracking, not just 0.
+    return b"".join(b">s%d\n%s\n" % (i, b"ACGT" * 400)
+                    for i in range(n_records))
+
+
+def test_truncated_gzip_reports_offset(tmp_path):
+    """A gzip member cut mid-stream (interrupted download) must raise
+    the parser's own typed error with the decompressed byte offset it
+    reached — never silently yield the short record set."""
+    p = tmp_path / "trunc.fasta.gz"
+    payload = _big_payload()
+    _truncated_gz(p, payload)
+    parser = FastaParser(str(p))
+    with pytest.raises(ParseError, match="corrupt or mislabelled") as ei:
+        parser.parse_all()
+    assert isinstance(ei.value.offset, int)
+    assert 0 < ei.value.offset <= len(payload)
+    # The parser is poisoned: a retried parse cannot masquerade as a
+    # clean EOF on a prefix of the records.
+    with pytest.raises(ParseError, match="previously failed"):
+        parser.parse()
+
+
+def test_injected_read_fault_is_typed_parse_error(tmp_path):
+    """The io/read drill site: an injected stream failure converts the
+    same way a real truncation does — typed, offset-bearing."""
+    from racon_tpu.resilience import faults
+    p = tmp_path / "x.fasta"
+    good = b">s0\nACGT\n"
+    p.write_bytes(good + b">s1\nTTTT\n")
+    faults.configure("io/read:2")      # fail reading the 3rd line
+    try:
+        with pytest.raises(ParseError, match="read failure") as ei:
+            FastaParser(str(p)).parse_all()
+        assert ei.value.offset == len(good) + len(b">s1\n")
+    finally:
+        faults.configure(None)
+
+
+def test_scan_index_truncated_gzip_reports_offset(tmp_path):
+    from racon_tpu.io.parsers import scan_sequence_index
+    payload = _big_payload()
+    whole = tmp_path / "ok.fasta.gz"
+    with gzip.open(whole, "wb") as f:
+        f.write(payload)
+    count, offsets = scan_sequence_index(str(whole))
+    assert count == 6000 and len(offsets) == 6000
+
+    p = tmp_path / "trunc.fasta.gz"
+    _truncated_gz(p, payload)
+    with pytest.raises(ParseError,
+                       match="corrupt or truncated sequence") as ei:
+        scan_sequence_index(str(p))
+    assert isinstance(ei.value.offset, int)
+    assert 0 < ei.value.offset <= len(payload)
+
+
 # ------------------------- reference dataset golden counts -------------------
 
 
