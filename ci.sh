@@ -68,4 +68,7 @@ python scripts/fleet_obs_smoke.py
 echo "[ci] failslow smoke (choke-point hangs, stage stall, self-eviction + merge byte-diff)"
 python scripts/failslow_smoke.py
 
+echo "[ci] chaos bench smoke (autoscaled fleet, evictions + straggler, makespan bound + byte-diff)"
+python scripts/chaos_bench.py --smoke
+
 echo "[ci] OK"

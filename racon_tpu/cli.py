@@ -126,6 +126,14 @@ def build_parser() -> argparse.ArgumentParser:
                          "evicted worker's shard becomes stealable S "
                          "seconds after its last renewal (each "
                          "committed contig renews)")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="supervise an elastic fleet against "
+                         "--ledger-dir instead of polishing: spawn "
+                         "worker subprocesses (this same command minus "
+                         "--autoscale) up to --workers, replace sick "
+                         "ones, retire surplus, and emit the merged "
+                         "FASTA on stdout (RACON_TPU_AUTOSCALE_* "
+                         "tunes the policy; see docs/DISTRIBUTED.md)")
     ap.add_argument("--version", action="store_true",
                     help="prints the version number")
     ap.add_argument("-h", "--help", action="store_true",
@@ -134,6 +142,9 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    # The autoscaler re-executes this same command line per spawned
+    # worker, so keep the unparsed form around.
+    raw_argv = list(argv) if argv is not None else sys.argv[1:]
     ap = build_parser()
     args = ap.parse_args(argv)
 
@@ -160,13 +171,23 @@ def main(argv: Optional[List[str]] = None) -> int:
         # Live OpenMetrics pull endpoint (daemon thread, dies with the
         # process): serves this worker's registry; fleet-wide scrapes
         # aggregate the ledger dir via scripts/obs_export.py instead.
-        from racon_tpu.obs.export import render_registry, serve_metrics
+        from racon_tpu.obs.export import (fleet_health, render_registry,
+                                          serve_metrics)
         from racon_tpu.obs.metrics import registry as _reg
         from racon_tpu.resilience.watchdog import health_snapshot
+        if args.ledger_dir:
+            # Fleet members (and the supervisor) answer /healthz with
+            # the whole fleet's view — live/evicted/retired workers,
+            # open shards, autoscaler heartbeat age; a dead supervisor
+            # turns the probe 503 so orchestrators restart it.
+            _ld = args.ledger_dir
+            health = lambda: fleet_health(_ld, base=health_snapshot)
+        else:
+            health = health_snapshot
         try:
             serve_metrics(int(metrics_port),
                           lambda: render_registry(_reg().snapshot()),
-                          health=health_snapshot)
+                          health=health)
         except (ValueError, OSError) as exc:
             print(f"[racon_tpu::] error: cannot serve metrics on port "
                   f"{metrics_port!r}: {exc}", file=sys.stderr)
@@ -236,6 +257,25 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"[racon_tpu::] error: invalid --lease-s {args.lease_s}!",
               file=sys.stderr)
         return 1
+    if args.autoscale:
+        if not args.ledger_dir:
+            print("[racon_tpu::] error: --autoscale requires "
+                  "--ledger-dir!", file=sys.stderr)
+            return 1
+        # Supervisor mode: no polishing in this process — spawn and
+        # shepherd worker subprocesses (this same command line minus
+        # --autoscale) until the merged FASTA lands, then emit it.
+        from racon_tpu.distributed.autoscaler import run_supervisor
+        from racon_tpu.distributed.ledger import LedgerError
+        try:
+            return run_supervisor(ledger_dir=args.ledger_dir,
+                                  raw_argv=raw_argv,
+                                  default_max=args.workers, out=out)
+        except LedgerError as exc:
+            print(str(exc), file=sys.stderr)
+            return 1
+        finally:
+            tracer.finish()
     # Everything that changes emitted bytes goes into the run
     # fingerprint (checkpoint and ledger identity alike); backend /
     # mesh / pipeline knobs are excluded because the execution paths

@@ -1,15 +1,22 @@
-"""Contig work ledger: shards, leases, stealing, ordered merge.
+"""Contig work ledger: shards, leases, stealing, splitting, ordered merge.
 
 The ledger is a directory on a filesystem every worker can reach::
 
     <ledger-dir>/
       meta.json          run identity + shard partition (published once,
                          atomically — publish_exclusive)
-      events.jsonl       append-only audit log (claims/steals/completes)
+      events.jsonl       append-only audit log (claims/steals/completes/
+                         splits, plus the autoscaler's spawn/retire)
       shard_<k>.lease    {"name", "worker", "epoch", "nonce", "deadline"}
       shard_<k>.done     completion marker (lease-fenced write)
       shard_<k>/         that shard's CheckpointStore (meta.json,
                          contigs.fasta, manifest.jsonl)
+      shard_<k>s<e>_<i>.range
+                         a child shard carved off shard_<k> by a dynamic
+                         split: {"parent", "start", "end", ...} published
+                         atomically (publish_exclusive). The child has
+                         its own lease/done/store files under its own
+                         name and is itself splittable, so lineages nest.
       merge.lease        the merge phase is itself a stealable
       merge.done         pseudo-shard, so a worker evicted mid-merge
       out.fasta          doesn't strand the run
@@ -37,18 +44,27 @@ nonces — with rename-atomic lease files, the last writer wins and every
 loser observes a foreign nonce. Lease clocks honor ``clock_skew()``
 (the ``skew=`` fault clause), so expiry is provable in tier-1 without
 wall-clock waits.
+
+The published partition is only the *initial* one: a worker stuck on a
+long shard can :meth:`WorkLedger.split` it at a committed-contig
+boundary, carving the tail into a new instantly-stealable child shard
+(docs/DISTRIBUTED.md "Elastic fleets"). :meth:`all_shards` is the
+single source of truth for what is claimable: base shards with every
+child's carve applied, effective ranges tiling [0, n_targets) exactly.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import re
 import time
-from typing import Dict, Iterator, List, Optional, Tuple
+from typing import Dict, Iterator, List, Optional, Tuple, Union
 
 from racon_tpu.obs.metrics import record_dist
 from racon_tpu.resilience import checkpoint as ckpt
-from racon_tpu.resilience.faults import clock_skew, maybe_fault
+from racon_tpu.resilience.faults import (clock_skew, hard_exit,
+                                         maybe_fault, maybe_torn)
 from racon_tpu.utils.atomicio import (append_fsync, atomic_write_bytes,
                                       atomic_writer, publish_exclusive)
 
@@ -57,7 +73,62 @@ META_NAME = "meta.json"
 EVENTS_NAME = "events.jsonl"
 MERGE_NAME = "merge"
 OUT_NAME = "out.fasta"
+RANGE_SUFFIX = ".range"
 ENV_SHARDS = "RACON_TPU_DIST_SHARDS"
+ENV_SPLIT = "RACON_TPU_SPLIT"
+
+
+def split_enabled() -> bool:
+    """Dynamic shard splitting is on unless RACON_TPU_SPLIT=0 — the
+    off switch exists so the monster-contig drill (scripts/
+    chaos_bench.py --monster) can measure the serialized tail it
+    kills."""
+    return os.environ.get(ENV_SPLIT, "1").strip().lower() not in (
+        "0", "false", "no", "off")
+
+
+ENV_SPLIT_DEPTH = "RACON_TPU_SPLIT_DEPTH"
+
+_SPLIT_SEG = re.compile(r"s\d+_\d+")
+
+
+def split_depth(name: str) -> int:
+    """How many split generations deep a shard name is (0 for a seed
+    shard): every :meth:`WorkLedger.split` appends one
+    ``s<epoch>_<seq>`` segment to the parent's name."""
+    return len(_SPLIT_SEG.findall(name))
+
+
+def max_split_depth() -> int:
+    """Depth cap for dynamic splitting (RACON_TPU_SPLIT_DEPTH,
+    default 1: seed shards split, children don't). Every handoff costs
+    the new holder a fresh polisher build, so without a cap two
+    workers trading a shrinking tail back and forth — each donating
+    its remainder to the other the moment the other goes idle — turn
+    one shard into a cascade of one-contig claims that is strictly
+    slower than never splitting at all."""
+    env = os.environ.get(ENV_SPLIT_DEPTH, "").strip()
+    if env:
+        try:
+            return max(0, int(env))
+        except ValueError:
+            pass
+    return 1
+
+
+def append_event(directory: str, rec: Dict) -> None:
+    """Append one record to the ledger's events.jsonl. O_APPEND:
+    concurrent single-write appends from multiple processes interleave
+    whole records. The log is advisory (timelines, obs_report), so
+    failures are swallowed — module-level so the autoscaler can log
+    spawn/retire decisions without holding a ledger."""
+    rec = dict(rec, t=round(time.time(), 3))
+    data = (json.dumps(rec, sort_keys=True) + "\n").encode()
+    try:
+        with open(os.path.join(directory, EVENTS_NAME), "ab") as fh:
+            append_fsync(fh, data)
+    except OSError:
+        pass
 
 
 class LedgerError(ValueError):
@@ -77,16 +148,51 @@ class LeaseLost(RuntimeError):
         self.name = name
 
 
+class ShardInfo:
+    """One claimable unit of work: a base shard of the published
+    partition, or a child carved off a parent by a dynamic split.
+
+    ``end`` is the *effective* end — the published end minus every
+    child carved off this shard's tail — so effective ranges always
+    tile [0, n_targets). ``root`` is the base-partition index the
+    lineage descends from (the int metrics/trace tag); ``key`` seeds
+    the checkpoint fingerprint, so a parent store and a child store are
+    mutually unspliceable even though they cover adjacent targets.
+    """
+
+    __slots__ = ("name", "key", "start", "end", "parent", "root")
+
+    def __init__(self, name: str, key: Union[int, str], start: int,
+                 end: int, parent: Optional[str] = None, root: int = 0):
+        self.name = name
+        self.key = key
+        self.start = int(start)
+        self.end = int(end)
+        self.parent = parent
+        self.root = int(root)
+
+    @property
+    def n_targets(self) -> int:
+        return self.end - self.start
+
+    def __repr__(self) -> str:  # debugging/log aid only
+        return (f"ShardInfo({self.name}, [{self.start}, {self.end})"
+                f"{', child of ' + self.parent if self.parent else ''})")
+
+
 class Claim:
-    """A held lease. ``shard`` is the shard index (-1 for the merge
-    pseudo-shard); ``stolen`` records whether this claim evicted a
-    previous holder (its committed prefix will be resumed)."""
+    """A held lease. ``shard`` is the lineage-root shard index (-1 for
+    the merge pseudo-shard); ``info`` carries the claimed shard's
+    effective range (None for merge); ``stolen`` records whether this
+    claim evicted a previous holder (its committed prefix will be
+    resumed)."""
 
     __slots__ = ("name", "shard", "worker", "epoch", "nonce", "stolen",
-                 "deadline")
+                 "deadline", "info")
 
     def __init__(self, name: str, shard: int, worker: str, epoch: int,
-                 nonce: str, stolen: bool, deadline: float):
+                 nonce: str, stolen: bool, deadline: float,
+                 info: Optional[ShardInfo] = None):
         self.name = name
         self.shard = shard
         self.worker = worker
@@ -94,6 +200,7 @@ class Claim:
         self.nonce = nonce
         self.stolen = stolen
         self.deadline = deadline
+        self.info = info
 
 
 def _partition(n_targets: int, n_shards: int) -> List[int]:
@@ -206,6 +313,21 @@ class WorkLedger:
                 "by this worker")
         return cls(directory, published)
 
+    @classmethod
+    def attach(cls, directory: str) -> "WorkLedger":
+        """Read-mostly attach for tooling — the autoscaler, the
+        /healthz fleet view, obs_report — which observes shard/lease
+        state but never polishes or merges: it adopts whatever
+        fingerprint the published meta carries instead of proving its
+        own inputs match."""
+        meta = cls._read_meta(os.path.join(directory, META_NAME),
+                              directory)
+        if meta.get("schema") != SCHEMA:
+            raise LedgerError(
+                f"[racon_tpu::dist] ledger schema "
+                f"{meta.get('schema')!r} != {SCHEMA}")
+        return cls(directory, meta)
+
     @staticmethod
     def _read_meta(path: str, directory: str) -> Dict:
         try:
@@ -220,11 +342,18 @@ class WorkLedger:
     def shard_range(self, k: int) -> Tuple[int, int]:
         return self.bounds[k], self.bounds[k + 1]
 
-    def shard_ckpt_dir(self, k: int) -> str:
-        return os.path.join(self.directory, f"shard_{k}")
+    def shard_ckpt_dir(self, k: Union[int, str, ShardInfo]) -> str:
+        if isinstance(k, ShardInfo):
+            name = k.name
+        elif isinstance(k, str):
+            name = k
+        else:
+            name = f"shard_{k}"
+        return os.path.join(self.directory, name)
 
-    def shard_fp(self, k: int) -> str:
-        return ckpt.shard_fingerprint(self.fingerprint, k)
+    def shard_fp(self, k: Union[int, str, ShardInfo]) -> str:
+        key = k.key if isinstance(k, ShardInfo) else k
+        return ckpt.shard_fingerprint(self.fingerprint, key)
 
     @property
     def out_path(self) -> str:
@@ -236,21 +365,15 @@ class WorkLedger:
     def _done_path(self, name: str) -> str:
         return os.path.join(self.directory, f"{name}.done")
 
+    def _range_path(self, name: str) -> str:
+        return os.path.join(self.directory, f"{name}{RANGE_SUFFIX}")
+
     def _now(self) -> float:
         return time.time() + clock_skew()
 
     # ------------------------------------------------------ events
     def _event(self, rec: Dict) -> None:
-        rec = dict(rec, t=round(time.time(), 3))
-        data = (json.dumps(rec, sort_keys=True) + "\n").encode()
-        # O_APPEND: concurrent single-write appends from multiple
-        # workers interleave whole records. Advisory, so best-effort.
-        try:
-            with open(os.path.join(self.directory, EVENTS_NAME),
-                      "ab") as fh:
-                append_fsync(fh, data)
-        except OSError:
-            pass
+        append_event(self.directory, rec)
 
     def events(self) -> List[Dict]:
         from racon_tpu.utils.atomicio import load_jsonl_prefix
@@ -259,6 +382,91 @@ class WorkLedger:
             return []
         records, _ = load_jsonl_prefix(path)
         return records
+
+    # ------------------------------------------------------ shards
+    def _read_range(self, path: str) -> Optional[Dict]:
+        """A child shard's published .range record, or None when the
+        file is torn, foreign, or structurally invalid — an invalid
+        .range means the split never happened (the dist/split torn
+        drill's contract: a half-published child is invisible)."""
+        try:
+            with open(path, "rb") as fh:
+                rec = json.loads(fh.read())
+        except (OSError, ValueError):
+            return None
+        if not isinstance(rec, dict):
+            return None
+        try:
+            name = rec["name"]
+            parent = rec["parent"]
+            start, end = int(rec["start"]), int(rec["end"])
+            root = int(rec["root"])
+        except (KeyError, TypeError, ValueError):
+            return None
+        if rec.get("fingerprint") != self.fingerprint:
+            return None
+        if not (isinstance(name, str) and isinstance(parent, str)):
+            return None
+        if not 0 <= start < end <= self.n_targets:
+            return None
+        return {"name": name, "parent": parent, "start": start,
+                "end": end, "root": root}
+
+    def all_shards(self) -> List[ShardInfo]:
+        """Every claimable shard — the published base partition plus
+        all dynamically split children — with carving applied: each
+        child shrinks its parent's effective end to the child's start,
+        so the effective ranges tile [0, n_targets) exactly, in start
+        order. Rescans the directory: a split published by another
+        worker is visible at this worker's next claim poll."""
+        infos: Dict[str, ShardInfo] = {}
+        for k in range(self.n_shards):
+            s, e = self.bounds[k], self.bounds[k + 1]
+            infos[f"shard_{k}"] = ShardInfo(f"shard_{k}", k, s, e,
+                                            None, k)
+        try:
+            entries = sorted(os.listdir(self.directory))
+        except OSError:
+            entries = []
+        for fn in entries:
+            if not fn.endswith(RANGE_SUFFIX):
+                continue
+            rec = self._read_range(os.path.join(self.directory, fn))
+            if rec is None or rec["name"] != fn[:-len(RANGE_SUFFIX)]:
+                continue
+            # A child's name extends its parent's ("<parent>s<e>_<i>"),
+            # so the sorted scan inserts parents before their children
+            # and nested lineages resolve in one pass.
+            if rec["parent"] not in infos:
+                continue
+            infos[rec["name"]] = ShardInfo(
+                rec["name"], rec["name"][len("shard_"):],
+                rec["start"], rec["end"], rec["parent"], rec["root"])
+        for info in infos.values():
+            if info.parent is not None:
+                parent = infos[info.parent]
+                if info.start < parent.end:
+                    parent.end = info.start
+        return sorted(infos.values(), key=lambda i: (i.start, i.end))
+
+    def open_shard_stats(self) -> Dict[str, int]:
+        """One pass over live shard state: ``open`` (not done),
+        ``claimable`` (open with no live lease — a steal or first
+        claim would succeed right now), ``leased`` (open under a live
+        lease). Feeds the worker's split trigger and the autoscaler's
+        target policy."""
+        now = self._now()
+        stats = {"open": 0, "claimable": 0, "leased": 0}
+        for info in self.all_shards():
+            if info.start >= info.end or self.is_done(info.name):
+                continue
+            stats["open"] += 1
+            cur = self._read_lease(info.name)
+            if cur is not None and float(cur.get("deadline", 0.0)) > now:
+                stats["leased"] += 1
+            else:
+                stats["claimable"] += 1
+        return stats
 
     # ------------------------------------------------------ leases
     def _read_lease(self, name: str) -> Optional[Dict]:
@@ -277,8 +485,8 @@ class WorkLedger:
     def is_done(self, name: str) -> bool:
         return os.path.exists(self._done_path(name))
 
-    def _try_claim(self, name: str, shard: int,
-                   worker: str) -> Optional[Claim]:
+    def _try_claim(self, name: str, shard: int, worker: str,
+                   info: Optional[ShardInfo] = None) -> Optional[Claim]:
         """Claim ``name`` if unclaimed, or steal it if its lease
         expired. Returns None when someone else holds a live lease (or
         won the race)."""
@@ -298,15 +506,17 @@ class WorkLedger:
                 record_dist("claims" if shard >= 0 else "merge_claims",
                             shard, worker)
                 return Claim(name, shard, worker, 1, nonce, False,
-                             lease["deadline"])
+                             lease["deadline"], info)
             # Lost the first-claim race; fall through and look at what
             # the winner published.
         cur = self._read_lease(name)
         if cur is not None and float(cur.get("deadline", 0.0)) > now:
             return None  # live lease — not ours to touch
-        # Expired (or torn) lease: steal by rewriting it, then verify
-        # our write survived — concurrent stealers race on the rename
-        # and every loser sees a foreign nonce on re-read.
+        # Expired, explicitly released, or torn lease: take it by
+        # rewriting, then verify our write survived — concurrent takers
+        # race on the rename and every loser sees a foreign nonce on
+        # re-read.
+        released = bool(cur.get("released")) if cur else False
         epoch = int(cur.get("epoch", 0)) + 1 if cur else 1
         expired_for = max(0.0, now - float(cur.get("deadline", now))) \
             if cur else 0.0
@@ -317,7 +527,18 @@ class WorkLedger:
             lease, sort_keys=True) + "\n").encode())
         back = self._read_lease(name)
         if back is None or back.get("nonce") != nonce:
-            return None  # another stealer's rename landed after ours
+            return None  # another taker's rename landed after ours
+        if released:
+            # A released marker is a cooperative handoff, not an
+            # eviction: count it as a claim, and ``stolen`` stays False
+            # (the committed prefix still resumes — resume keys off the
+            # store, not the flag).
+            self._event({"ev": "claim", "name": name, "worker": worker,
+                         "epoch": epoch, "released_by": victim})
+            record_dist("claims" if shard >= 0 else "merge_claims",
+                        shard, worker)
+            return Claim(name, shard, worker, epoch, nonce, False,
+                         lease["deadline"], info)
         if shard >= 0:
             record_dist("leases_expired", shard, worker)
             record_dist("shards_stolen", shard, worker, epoch=epoch)
@@ -329,15 +550,28 @@ class WorkLedger:
                      "victim": victim, "epoch": epoch,
                      "expired_for_s": round(expired_for, 3)})
         return Claim(name, shard, worker, epoch, nonce, True,
-                     lease["deadline"])
+                     lease["deadline"], info)
 
-    def claim_shard(self, worker: str) -> Optional[Claim]:
-        """The next shard this worker can own, scanning in index order
-        (earliest incomplete work first, which also keeps the merge's
-        wait roughly FIFO). None when every shard is done or
-        live-leased elsewhere."""
-        for k in range(self.n_shards):
-            claim = self._try_claim(f"shard_{k}", k, worker)
+    def claim_shard(self, worker: str,
+                    avoid: Optional[List[str]] = None) -> \
+            Optional[Claim]:
+        """The next shard this worker can own, scanning effective
+        shards (base partition plus split children) in target order —
+        earliest incomplete work first, which also keeps the merge's
+        wait roughly FIFO. ``avoid`` deprioritizes named shards (the
+        autoscaler hands a replacement worker the shard its sick
+        predecessor released) without ever excluding them: a wedged
+        shard is still claimed when nothing else is left. None when
+        every shard is done or live-leased elsewhere."""
+        avoided = set(avoid or ())
+        shards = self.all_shards()
+        ordered = [i for i in shards if i.name not in avoided] + \
+                  [i for i in shards if i.name in avoided]
+        for info in ordered:
+            if info.start >= info.end:
+                continue
+            claim = self._try_claim(info.name, info.root, worker,
+                                    info=info)
             if claim is not None:
                 return claim
         return None
@@ -369,13 +603,21 @@ class WorkLedger:
                      "worker": claim.worker, "epoch": claim.epoch})
 
     def release(self, claim: Claim) -> None:
-        """Hand a held lease back WITHOUT completing it — the self-
-        eviction path (resilience/watchdog.py): a worker that has
-        judged itself wedged unlinks its lease so any thief can claim
-        the shard immediately via the first-claim fast path instead of
+        """Hand a held lease back WITHOUT completing it — self-eviction
+        (resilience/watchdog.py) and supervisor-driven retirement: the
+        shard becomes claimable at any worker's next poll instead of
         waiting out the lease term. Committed prefix work stays in the
         shard's checkpoint store; the successor resumes it
         byte-identically.
+
+        The release is published as a *marker lease* (``released``,
+        deadline 0) via the same atomic rename every steal uses — never
+        an unlink. Check-then-unlink had a race window: a thief's
+        steal-rewrite landing between our nonce read and our remove
+        would be deleted, silently revoking the thief's freshly won
+        claim. Renames serialize instead — whichever lands last wins,
+        and the other side's nonce re-read refuses. Regression:
+        tests/test_distributed.py two-thief release/split race.
 
         A foreign nonce on disk means the lease was already stolen —
         benign (nonce fencing protects completion), so the release is
@@ -385,10 +627,11 @@ class WorkLedger:
         cur = self._read_lease(claim.name)
         if cur is None or cur.get("nonce") != claim.nonce:
             return
-        try:
-            os.remove(self._lease_path(claim.name))
-        except OSError:
-            return
+        marker = {"name": claim.name, "worker": claim.worker,
+                  "epoch": claim.epoch, "nonce": os.urandom(8).hex(),
+                  "deadline": 0.0, "released": True}
+        atomic_write_bytes(self._lease_path(claim.name), (json.dumps(
+            marker, sort_keys=True) + "\n").encode())
         record_dist("releases", claim.shard, claim.worker)
         self._event({"ev": "release", "name": claim.name,
                      "worker": claim.worker, "epoch": claim.epoch})
@@ -404,13 +647,94 @@ class WorkLedger:
             rec, sort_keys=True) + "\n").encode())
         self._event(dict(rec, ev="complete"))
 
-    def shards_done(self) -> bool:
-        return all(self.is_done(f"shard_{k}")
-                   for k in range(self.n_shards))
+    # ------------------------------------------------------- split
+    def split(self, claim: Claim, cut: int) -> Optional[ShardInfo]:
+        """Carve ``[cut, end)`` off a held shard into a new child shard
+        that any idle worker can claim immediately — the dynamic
+        re-sharding that kills the monster-contig tail
+        (docs/DISTRIBUTED.md "Elastic fleets").
 
-    def pending_shards(self) -> List[int]:
-        return [k for k in range(self.n_shards)
-                if not self.is_done(f"shard_{k}")]
+        Protocol (nonce-fenced both sides of the publish):
+
+        1. verify the lease — only the live holder may split;
+        2. publish the child's ``.range`` file with publish_exclusive
+           (``dist/split`` is the torn-write drill site: a split that
+           dies mid-publish must be invisible, so readers drop
+           unparseable .range files);
+        3. re-verify — if the lease was stolen inside the publish
+           window, the thief claimed the *full* parent range, so the
+           child is retracted (unlinked) and LeaseLost raised; without
+           the retraction the fleet could polish [cut, end) twice under
+           two names and the tiling check would refuse the merge.
+
+        The child gets its own lease/done/checkpoint files under its
+        own name and a checkpoint fingerprint derived from that name,
+        so parent and child stores are mutually unspliceable; its
+        ``.range`` record carries the parent name, making lineage
+        reconstructable (obs_report --fleet renders the chain). Returns
+        the child's ShardInfo, or None when the publish lost a name
+        race (the caller may simply retry later). ``claim.info.end``
+        shrinks to ``cut`` on success.
+        """
+        info = claim.info
+        if info is None:
+            raise LedgerError(
+                "[racon_tpu::dist] only shard claims can split")
+        if not info.start < cut < info.end:
+            raise LedgerError(
+                f"[racon_tpu::dist] split cut {cut} outside the held "
+                f"range [{info.start}, {info.end}) of {info.name}")
+        self.verify(claim)
+        try:
+            n_prior = sum(
+                1 for fn in os.listdir(self.directory)
+                if fn.startswith(info.name + "s") and
+                fn.endswith(RANGE_SUFFIX))
+        except OSError:
+            n_prior = 0
+        child = f"{info.name}s{claim.epoch}_{n_prior + 1}"
+        rec = {"schema": SCHEMA, "name": child, "parent": info.name,
+               "root": info.root, "start": int(cut),
+               "end": int(info.end), "fingerprint": self.fingerprint}
+        blob = (json.dumps(rec, sort_keys=True) + "\n").encode()
+        path = self._range_path(child)
+        if maybe_torn("dist/split"):
+            # The drill: die mid-publish leaving a truncated .range at
+            # the final path (publish_exclusive's tmp+link can't tear,
+            # so the drill bypasses it), durable, then hard-exit —
+            # readers must treat the torn child as "no split happened".
+            with open(path, "wb") as fh:
+                fh.write(blob[:max(1, len(blob) - 9)])
+                fh.flush()
+                os.fsync(fh.fileno())
+            hard_exit(137)
+        if not publish_exclusive(path, blob):
+            return None
+        try:
+            self.verify(claim)
+        except LeaseLost:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+            raise
+        old_end, info.end = info.end, int(cut)
+        record_dist("splits_total", info.root, claim.worker,
+                    child=child)
+        self._event({"ev": "split", "name": info.name, "child": child,
+                     "worker": claim.worker, "epoch": claim.epoch,
+                     "start": int(cut), "end": int(old_end)})
+        return ShardInfo(child, child[len("shard_"):], cut, old_end,
+                         info.name, info.root)
+
+    # ----------------------------------------------------- progress
+    def shards_done(self) -> bool:
+        return all(self.is_done(i.name) for i in self.all_shards()
+                   if i.start < i.end)
+
+    def pending_shards(self) -> List[str]:
+        return [i.name for i in self.all_shards()
+                if i.start < i.end and not self.is_done(i.name)]
 
     def merge_done(self) -> bool:
         return self.is_done(MERGE_NAME) and os.path.exists(
@@ -419,25 +743,40 @@ class WorkLedger:
     # ------------------------------------------------------- merge
     def iter_merged(self) -> Iterator[Tuple[int, Optional[bytes]]]:
         """Yield ``(tid, blob-or-None)`` in target input order across
-        all shard stores — the exact bytes each shard committed, so
-        concatenation is byte-identical to the serial path. Requires
-        every shard done."""
-        for k in range(self.n_shards):
-            start, end = self.shard_range(k)
-            if start == end:
+        all shard stores — base shards and split children stitched by
+        their effective ranges — the exact bytes each shard committed,
+        so concatenation is byte-identical to the serial path. Requires
+        every shard done; refuses when the split lineage does not tile
+        the target range (a corrupt .range escaped the readers'
+        validation)."""
+        pos = 0
+        for info in self.all_shards():
+            if info.start >= info.end:
                 continue
-            store = ckpt.CheckpointStore.resume(self.shard_ckpt_dir(k),
-                                                self.shard_fp(k))
+            if info.start != pos:
+                raise LedgerError(
+                    f"[racon_tpu::dist] split lineage does not tile "
+                    f"the target range: expected a shard starting at "
+                    f"{pos}, found {info.name} at {info.start} — "
+                    "ledger corrupt")
+            pos = info.end
+            store = ckpt.CheckpointStore.resume(
+                self.shard_ckpt_dir(info), self.shard_fp(info))
             try:
-                for tid in range(start, end):
+                for tid in range(info.start, info.end):
                     if tid not in store.committed:
                         raise LedgerError(
-                            f"[racon_tpu::dist] shard {k} is marked "
-                            f"done but target {tid} has no committed "
-                            "record — ledger corrupt")
+                            f"[racon_tpu::dist] shard {info.name} is "
+                            f"marked done but target {tid} has no "
+                            "committed record — ledger corrupt")
                     yield tid, store.read_emitted(tid)
             finally:
                 store.close()
+        if pos != self.n_targets:
+            raise LedgerError(
+                f"[racon_tpu::dist] split lineage does not tile the "
+                f"target range: coverage ends at {pos}, expected "
+                f"{self.n_targets} — ledger corrupt")
 
     def merge(self) -> Tuple[int, int]:
         """Assemble ``out.fasta`` from the shard stores (caller holds

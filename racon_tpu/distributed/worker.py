@@ -1,4 +1,4 @@
-"""The distributed worker loop: claim → polish → complete → merge.
+"""The distributed worker loop: claim → polish → split → complete → merge.
 
 One ``racon_tpu --ledger-dir`` invocation is one worker. Workers share
 nothing but the ledger directory; each runs the full single-process
@@ -16,10 +16,22 @@ is recoverable:
 - mid-merge: the merge is a lease-fenced pseudo-shard writing through
   tmp+rename — a dead merger's thief redoes the cheap read-only pass.
 
+Dynamic splitting (docs/DISTRIBUTED.md "Elastic fleets"): a worker
+holding a long-running shard while the rest of the fleet is starved —
+idle live workers and nothing claimable — carves the uncommitted tail
+past its in-flight contig into a child shard any idle worker claims at
+its next poll. The trigger is evaluated when a shard is (re)claimed
+(BEFORE the polisher is built, so the donated range's consensus is
+never computed here at all) and again after every commit (frees the
+tail mid-shard in pipeline mode). ``RACON_TPU_SPLIT=0`` disables;
+``RACON_TPU_SPLIT_AFTER_S`` sets how long a shard must have been held
+first (default: one lease term; 0 splits at the first starved poll).
+
 Fault sites: ``dist/shard`` fires once per claimed shard (before any
 polishing), ``dist/contig`` once per retired contig (before its
-commit), ``dist/claim`` per claim attempt, ``dist/merge`` before the
-merge pass — so eviction drills can target any phase deterministically.
+commit), ``dist/claim`` per claim attempt, ``dist/split`` inside the
+split publication, ``dist/merge`` before the merge pass — so eviction
+drills can target any phase deterministically.
 """
 
 from __future__ import annotations
@@ -29,6 +41,7 @@ import sys
 import time
 from typing import Callable, Optional
 
+from racon_tpu.distributed import ledger as dledger
 from racon_tpu.distributed.ledger import Claim, LeaseLost, WorkLedger
 from racon_tpu.obs import fleet
 from racon_tpu.obs.metrics import record_dist, set_dist
@@ -37,6 +50,8 @@ from racon_tpu.resilience import checkpoint as ckpt
 from racon_tpu.resilience.faults import maybe_fault
 
 ENV_POLL = "RACON_TPU_DIST_POLL"
+ENV_AVOID = "RACON_TPU_DIST_AVOID"
+ENV_SPLIT_AFTER = "RACON_TPU_SPLIT_AFTER_S"
 
 
 def default_worker_id() -> str:
@@ -53,40 +68,118 @@ def _poll_interval(lease_s: float) -> float:
     return min(1.0, max(0.05, lease_s / 10.0))
 
 
-def _open_store(ledger: WorkLedger, k: int) -> ckpt.CheckpointStore:
-    d = ledger.shard_ckpt_dir(k)
-    fp = ledger.shard_fp(k)
+def _avoid_shards() -> list:
+    """Shard names this worker should claim LAST (never excluded) —
+    seeded by the autoscaler when replacing a self-evicted worker, so
+    the replacement doesn't immediately re-claim the assignment that
+    wedged its predecessor."""
+    env = os.environ.get(ENV_AVOID, "")
+    return [s for s in (p.strip() for p in env.split(",")) if s]
+
+
+def _split_after_s(lease_s: float) -> float:
+    env = os.environ.get(ENV_SPLIT_AFTER, "").strip()
+    if env:
+        try:
+            return max(0.0, float(env))
+        except ValueError:
+            pass
+    # One lease term of evidence that the shard is long before
+    # fragmenting it; a floor keeps tiny test leases from splitting
+    # every run.
+    return max(5.0, lease_s)
+
+
+def _live_workers(ledger_dir: str) -> int:
+    """Workers whose latest metric snapshot is not final — the best
+    coordinator-free liveness proxy. A kill -9 victim counts as live
+    until its lease expires and a steal resolves it, which at worst
+    delays a split by one trigger evaluation."""
+    try:
+        shards = fleet.load_worker_shards(fleet.obs_dir_for(ledger_dir))
+    except OSError:
+        return 0
+    return sum(1 for sh in shards
+               if sh["records"] and not sh["records"][-1].get("final"))
+
+
+def _maybe_split(ledger: WorkLedger, claim: Claim, next_tid: int,
+                 t_shard: float, log) -> bool:
+    """Evaluate the split trigger and, when the fleet is starved, carve
+    ``[next_tid + 1, end)`` off the held shard (keep the in-flight
+    contig, donate everything behind it). Returns True when a child
+    was published; ``claim.info.end`` has shrunk then. Raises
+    LeaseLost if the lease was stolen inside the split protocol — the
+    caller's abandon path handles it like any other steal."""
+    info = claim.info
+    if info is None or not dledger.split_enabled():
+        return False
+    if dledger.split_depth(info.name) >= dledger.max_split_depth():
+        return False  # re-splitting children cascades into handoff thrash
+    if info.end - next_tid < 2:
+        return False  # nothing to donate beyond the in-flight contig
+    if time.monotonic() - t_shard < _split_after_s(ledger.lease_s):
+        return False
+    stats = ledger.open_shard_stats()
+    if stats["claimable"] > 0:
+        return False  # idle workers already have work to take
+    if _live_workers(ledger.directory) <= stats["leased"]:
+        return False  # nobody is idle — a split would only fragment
+    child = ledger.split(claim, next_tid + 1)
+    if child is None:
+        return False
+    print(f"[racon_tpu::dist] worker {claim.worker}: split "
+          f"{info.name} at {next_tid + 1} — child {child.name} "
+          f"[{child.start}, {child.end}) now stealable", file=log)
+    return True
+
+
+def _open_store(ledger: WorkLedger, shard) -> ckpt.CheckpointStore:
+    d = ledger.shard_ckpt_dir(shard)
+    fp = ledger.shard_fp(shard)
     if os.path.exists(os.path.join(d, ckpt.META_NAME)):
         return ckpt.CheckpointStore.resume(d, fp)
     return ckpt.CheckpointStore.create(d, fp)
 
 
 def _polish_shard(ledger: WorkLedger, claim: Claim,
-                  make_polisher: Callable,
-                  drop_unpolished: bool, log) -> int:
+                  make_polisher: Callable, drop_unpolished: bool, log,
+                  t_shard: float) -> int:
     """Polish one claimed shard to completion; returns the number of
-    committed targets. Raises LeaseLost the moment the lease is
-    observed stolen."""
-    k = claim.shard
-    start, end = ledger.shard_range(k)
-    store = _open_store(ledger, k)
+    committed targets in the shard's final effective range. Raises
+    LeaseLost the moment the lease is observed stolen."""
+    info = claim.info
+    store = _open_store(ledger, info)
     try:
+        start = info.start
         if store.committed:
             # A stolen (or re-claimed) shard: everything the victim
             # committed re-emits from its store, zero recompute.
-            record_dist("contigs_resumed", k, claim.worker,
+            record_dist("contigs_resumed", claim.shard, claim.worker,
                         value=len(store.committed))
             print(f"[racon_tpu::dist] worker {claim.worker}: shard "
-                  f"{k} resumes {len(store.committed)}/{end - start} "
-                  "committed contig(s) from previous holder",
-                  file=log)
-        if len(store.committed) < end - start:
+                  f"{info.name} resumes {len(store.committed)}/"
+                  f"{info.end - start} committed contig(s) from "
+                  "previous holder", file=log)
+        next_tid = start
+        while next_tid in store.committed:
+            next_tid += 1
+        # Claim-time trigger: splitting BEFORE the polisher is built
+        # means the donated range's windows are never constructed here
+        # — in serial engine mode all consensus compute runs up-front,
+        # so this is the evaluation that actually shortens the tail.
+        if next_tid < info.end:
+            _maybe_split(ledger, claim, next_tid, t_shard, log)
+        if any(tid not in store.committed
+               for tid in range(start, info.end)):
             polisher = make_polisher()
             polisher.initialize()
-            polisher.restrict_targets(range(start, end))
+            polisher.restrict_targets(range(start, info.end))
             if store.committed:
                 polisher.skip_targets(store.committed)
             for tid, rec in polisher.polish_records(drop_unpolished):
+                if tid >= info.end:
+                    break  # donated to a split child mid-run
                 maybe_fault("dist/contig")
                 ledger.renew(claim)
                 # Per-contig cadence: cheap (interval-gated) and tied
@@ -98,28 +191,33 @@ def _polish_shard(ledger: WorkLedger, claim: Claim,
                     store.commit(tid, rec.name.encode(), rec.data)
                 else:
                     store.commit_dropped(tid)
-                record_dist("contigs_polished", k, claim.worker,
-                            tid=tid)
+                record_dist("contigs_polished", claim.shard,
+                            claim.worker, tid=tid)
                 if claim.stolen:
-                    record_dist("contigs_repolished", k, claim.worker,
-                                tid=tid)
+                    record_dist("contigs_repolished", claim.shard,
+                                claim.worker, tid=tid)
+                if tid + 1 < info.end:
+                    _maybe_split(ledger, claim, tid + 1, t_shard, log)
         # Targets with zero windows never reach the assembler, so they
         # yield nothing above — commit them as drops explicitly so the
         # done marker really means "every tid in range accounted for".
-        for tid in range(start, end):
+        for tid in range(start, info.end):
             if tid not in store.committed:
                 ledger.renew(claim)
                 store.commit_dropped(tid)
-        return len(store.committed)
+        return info.end - start
     finally:
         store.close()
 
 
 def _merge_phase(ledger: WorkLedger, worker: str, out, log,
-                 poll: float) -> int:
+                 poll: float) -> Optional[int]:
     """Every worker races for the merge pseudo-shard; exactly one wins
     and emits the merged FASTA. Losers wait for the done marker so the
-    process exit means the run's output exists."""
+    process exit means the run's output exists. Returns None — back to
+    the shard loop — when a shard turns out to be pending after all: a
+    split child published inside the parent's completion race window
+    lands as new work, and the merge must wait for it."""
     import shutil
     while True:
         if ledger.merge_done():
@@ -129,8 +227,13 @@ def _merge_phase(ledger: WorkLedger, worker: str, out, log,
             return 0
         claim = ledger.claim_merge(worker)
         if claim is None:
+            if not ledger.shards_done():
+                return None  # late split child — resume polishing
             time.sleep(poll)
             continue
+        if not ledger.shards_done():
+            ledger.release(claim)
+            return None
         maybe_fault("dist/merge")
         try:
             nbytes, emitted = ledger.merge()
@@ -147,7 +250,7 @@ def _merge_phase(ledger: WorkLedger, worker: str, out, log,
         out.flush()
         print(f"[racon_tpu::dist] worker {worker}: merged "
               f"{emitted} contig(s), {nbytes} bytes, from "
-              f"{ledger.n_shards} shard(s)", file=log)
+              f"{len(ledger.all_shards())} shard(s)", file=log)
         return 0
 
 
@@ -186,64 +289,82 @@ def run_worker(*, ledger_dir: str, fingerprint: str,
                          worker, fingerprint)
     get_tracer().set_context(worker_id=worker, run_fp=fingerprint)
     poll = _poll_interval(ledger.lease_s)
+    avoid = _avoid_shards()
     print(f"[racon_tpu::dist] worker {worker}: joined ledger "
           f"{ledger_dir} ({ledger.n_targets} target(s) in "
           f"{ledger.n_shards} shard(s), lease {ledger.lease_s:g}s)",
           file=log)
 
-    while not ledger.shards_done():
-        claim = ledger.claim_shard(worker)
-        if claim is None:
-            # Everything is live-leased elsewhere: wait for a
-            # completion or an expiry to steal.
-            time.sleep(poll)
-            continue
-        maybe_fault("dist/shard")
-        get_tracer().set_context(shard=claim.shard)
-        t0 = time.perf_counter()
-        try:
-            n = _polish_shard(ledger, claim, make_polisher,
-                              drop_unpolished, log)
-            ledger.complete(claim, n_committed=n)
-        except LeaseLost:
-            # The shard was stolen while we held it (our own lease
-            # expired — e.g. a long pause). The thief owns the work
-            # now; our commits so far are still valid prefix for it.
-            print(f"[racon_tpu::dist] worker {worker}: abandoning "
-                  f"shard {claim.shard} — lease stolen while working",
-                  file=log)
-            continue
-        except BaseException as exc:  # noqa: BLE001 — terminal check only
-            # Fail-slow self-eviction: this host has crossed its
-            # terminal watchdog breach budget, so it hands the shard
-            # back EXPLICITLY (lease release — thieves claim it at the
-            # next poll instead of waiting out the lease term) and
-            # exits with a distinct code. Committed prefix work
-            # survives in the shard store; the successor resumes it
-            # byte-identically. Every other exception propagates so
-            # the process dies exactly as a preempted worker would.
-            from racon_tpu.resilience.watchdog import (EXIT_SELF_EVICT,
-                                                       is_terminal)
-            if not is_terminal(exc):
-                raise
-            ledger.release(claim)
-            record_dist("self_evictions", claim.shard, worker)
-            print(f"[racon_tpu::dist] worker {worker}: self-evicting "
-                  f"from shard {claim.shard} — {exc} (lease released; "
-                  f"exit {EXIT_SELF_EVICT})", file=log)
-            # The CLI tail handles fleet.flush_final() + tracer.finish
-            # on this return value, so the eviction leaves a final obs
-            # snapshot like any clean exit.
-            return EXIT_SELF_EVICT
-        finally:
-            get_tracer().set_context(shard=None)
-            fleet.maybe_flush()
-        record_dist("shards_completed", claim.shard, worker)
-        if claim.stolen:
-            record_dist("recovery_wall_s", claim.shard, worker,
-                        value=time.perf_counter() - t0)
-        print(f"[racon_tpu::dist] worker {worker}: shard "
-              f"{claim.shard} complete ({n} target(s))"
-              f"{' [stolen]' if claim.stolen else ''}", file=log)
+    while True:
+        while not ledger.shards_done():
+            claim = ledger.claim_shard(worker, avoid=avoid)
+            if claim is None:
+                # Everything is live-leased elsewhere: wait for a
+                # completion, an expiry to steal, or a split child.
+                time.sleep(poll)
+                continue
+            maybe_fault("dist/shard")
+            get_tracer().set_context(shard=claim.shard)
+            t0 = time.perf_counter()
+            try:
+                n = _polish_shard(ledger, claim, make_polisher,
+                                  drop_unpolished, log,
+                                  time.monotonic())
+                ledger.complete(claim, n_committed=n)
+            except LeaseLost:
+                # The shard was stolen while we held it (our own lease
+                # expired — e.g. a long pause). The thief owns the work
+                # now; our commits so far are still valid prefix for it.
+                print(f"[racon_tpu::dist] worker {worker}: abandoning "
+                      f"shard {claim.name} — lease stolen while "
+                      "working", file=log)
+                continue
+            except BaseException as exc:  # noqa: BLE001 — terminal check only
+                if getattr(exc, "signum", None) is not None:
+                    # Supervisor-driven retirement (SIGTERM routed
+                    # through the CLI's signal handler): hand the lease
+                    # back explicitly so the shard is claimable at the
+                    # fleet's next poll, then let the signal path finish
+                    # teardown (final snapshot, exit 128+signum).
+                    ledger.release(claim)
+                    record_dist("retires", claim.shard, worker)
+                    print(f"[racon_tpu::dist] worker {worker}: retiring"
+                          f" from shard {claim.name} on signal "
+                          f"{exc.signum} (lease released)", file=log)
+                    raise
+                # Fail-slow self-eviction: this host has crossed its
+                # terminal watchdog breach budget, so it hands the shard
+                # back EXPLICITLY (lease release — thieves claim it at
+                # the next poll instead of waiting out the lease term)
+                # and exits with a distinct code. Committed prefix work
+                # survives in the shard store; the successor resumes it
+                # byte-identically. Every other exception propagates so
+                # the process dies exactly as a preempted worker would.
+                from racon_tpu.resilience.watchdog import (
+                    EXIT_SELF_EVICT, is_terminal)
+                if not is_terminal(exc):
+                    raise
+                ledger.release(claim)
+                record_dist("self_evictions", claim.shard, worker)
+                print(f"[racon_tpu::dist] worker {worker}: "
+                      f"self-evicting from shard {claim.shard} — {exc} "
+                      f"(lease released; exit {EXIT_SELF_EVICT})",
+                      file=log)
+                # The CLI tail handles fleet.flush_final() +
+                # tracer.finish on this return value, so the eviction
+                # leaves a final obs snapshot like any clean exit.
+                return EXIT_SELF_EVICT
+            finally:
+                get_tracer().set_context(shard=None)
+                fleet.maybe_flush()
+            record_dist("shards_completed", claim.shard, worker)
+            if claim.stolen:
+                record_dist("recovery_wall_s", claim.shard, worker,
+                            value=time.perf_counter() - t0)
+            print(f"[racon_tpu::dist] worker {worker}: shard "
+                  f"{claim.name} complete ({n} target(s))"
+                  f"{' [stolen]' if claim.stolen else ''}", file=log)
 
-    return _merge_phase(ledger, worker, out, log, poll)
+        rc = _merge_phase(ledger, worker, out, log, poll)
+        if rc is not None:
+            return rc

@@ -166,6 +166,10 @@ def render_fleet(model: Dict) -> str:
     s = _Family(PREFIX + "fleet_steals", "counter",
                 "racon_tpu fleet: lease steals in events.jsonl")
     fam(s).add([], model.get("steals", 0))
+    sp = _Family(PREFIX + "fleet_splits", "counter",
+                 "racon_tpu fleet: dynamic shard splits in "
+                 "events.jsonl")
+    fam(sp).add([], model.get("splits", 0))
 
     per_worker = (
         ("windows_per_sec", "gauge",
@@ -189,6 +193,76 @@ def render_fleet(model: Dict) -> str:
             f.add([("shard", name)],
                   sum(1 for e in timeline[name] if e["ev"] == "steal"))
     return _render(list(fams.values()))
+
+
+# ---------------------------------------------------------- fleet health
+
+#: A supervisor heartbeat older than this many of its own declared
+#: intervals reads as a dead autoscaler (503 on /healthz).
+SUPERVISOR_STALE_FACTOR = 5.0
+
+
+def fleet_health(ledger_dir: str, base: Optional[Callable] = None,
+                 stale_factor: float = SUPERVISOR_STALE_FACTOR) -> Dict:
+    """The ``/healthz`` fleet view served when ``--ledger-dir`` is set:
+    the process-local watchdog snapshot (``base``, typically
+    watchdog.health_snapshot) extended with a ``"fleet"`` section —
+    worker counts (live/evicted/retired/done from the supervisor
+    heartbeat when one exists, else derived from metric-shard final
+    flags), open shard count, and the autoscaler's last-decision age.
+
+    Status degrades to ``"supervisor-dead"`` (→ 503, the probes'
+    eviction signal) when a heartbeat EXISTS but is older than
+    ``stale_factor`` × its own declared interval. A fleet that never
+    ran a supervisor is not penalized for its absence.
+    """
+    import time as _time
+
+    from racon_tpu.obs import fleet as _fleet
+
+    snap: Dict = dict(base()) if base is not None else {"status": "ok"}
+    view: Dict = {}
+    live = exited = 0
+    for sh in _fleet.load_worker_shards(_fleet.obs_dir_for(ledger_dir)):
+        if sh["records"][-1].get("final"):
+            exited += 1
+        else:
+            live += 1
+    view["workers_live"] = live
+    view["workers_exited"] = exited
+    try:
+        from racon_tpu.distributed.ledger import LedgerError, WorkLedger
+        try:
+            led = WorkLedger.attach(ledger_dir)
+            view["open_shards"] = len(led.pending_shards())
+            view["merge_done"] = led.merge_done()
+        except LedgerError:
+            view["open_shards"] = None  # meta not yet published
+    except Exception:  # pragma: no cover — probe must never raise
+        view["open_shards"] = None
+    hb = _fleet.load_supervisor(ledger_dir)
+    if hb is not None:
+        age = max(0.0, _time.time() - float(hb.get("unix_time", 0.0)))
+        interval = max(0.1, float(hb.get("interval_s", 1.0)))
+        view["autoscaler"] = {
+            "age_s": round(age, 3),
+            "interval_s": interval,
+            "target_workers": hb.get("target_workers"),
+            "live_workers": hb.get("live_workers"),
+            "done": bool(hb.get("done")),
+        }
+        for key in ("workers_live", "workers_evicted",
+                    "workers_retired", "workers_done"):
+            if key in hb:
+                view[key] = hb[key]
+        if age > stale_factor * interval and not hb.get("done") and \
+                snap.get("status") == "ok":
+            # The fleet may still finish on its own (workers hold the
+            # ledger, not the supervisor), but nobody is replacing
+            # evictions anymore — surface it as a liveness failure.
+            snap["status"] = "supervisor-dead"
+    snap["fleet"] = view
+    return snap
 
 
 # ------------------------------------------------------------ validation

@@ -51,6 +51,9 @@ from racon_tpu.utils.atomicio import (atomic_write_bytes, fsync_dir,
 SNAPSHOT_SCHEMA = 1
 OBS_SUBDIR = "obs"
 SHARD_SUFFIX = ".metrics.jsonl"
+#: The autoscaler's per-tick heartbeat (distributed/autoscaler.py),
+#: written atomically next to the worker metric shards.
+SUPERVISOR_NAME = "autoscaler.json"
 
 #: Serial CLI opt-in: point at a directory to get the same metric shard
 #: a fleet worker writes (the aggregator treats a one-shard directory
@@ -286,7 +289,7 @@ def _compress_timeline(events: List[Dict]) -> Dict[str, List[Dict]]:
         ev = rec.get("ev")
         if not isinstance(name, str) or ev not in ("claim", "renew",
                                                    "steal", "complete",
-                                                   "release"):
+                                                   "release", "split"):
             continue
         lane = timeline.setdefault(name, [])
         if ev == "renew" and lane and lane[-1]["ev"] == "renew" and \
@@ -302,10 +305,27 @@ def _compress_timeline(events: List[Dict]) -> Dict[str, List[Dict]]:
         if ev == "steal":
             entry["victim"] = rec.get("victim")
             entry["expired_for_s"] = rec.get("expired_for_s")
+        if ev == "split":
+            entry["child"] = rec.get("child")
         if "epoch" in rec:
             entry["epoch"] = rec.get("epoch")
         lane.append(entry)
     return timeline
+
+
+def load_supervisor(root: str) -> Optional[Dict]:
+    """The autoscaler's heartbeat (``obs/autoscaler.json``, written
+    atomically once per control tick), or None when no supervisor ever
+    attached to this ledger. Unreadable/torn heartbeats read as absent
+    — the /healthz staleness check only fires on a heartbeat that
+    parsed."""
+    path = os.path.join(obs_dir_for(root), SUPERVISOR_NAME)
+    try:
+        with open(path, "rb") as fh:
+            rec = json.loads(fh.read())
+    except (OSError, ValueError):
+        return None
+    return rec if isinstance(rec, dict) else None
 
 
 def aggregate(root: str) -> Dict:
@@ -398,12 +418,37 @@ def aggregate(root: str) -> Dict:
         events, _ = load_jsonl_prefix(events_path)
     timeline = _compress_timeline(events)
     steals = sum(1 for rec in events if rec.get("ev") == "steal")
+    splits = sum(1 for rec in events if rec.get("ev") == "split")
+    spawns = sum(1 for rec in events if rec.get("ev") == "spawn")
+    retires = sum(1 for rec in events if rec.get("ev") == "retire")
+    # Split lineage: child shard name -> parent shard name, so readers
+    # (obs_report --fleet) can render each lane's full ancestry chain.
+    lineage = {rec["child"]: rec["name"] for rec in events
+               if rec.get("ev") == "split" and
+               isinstance(rec.get("child"), str) and
+               isinstance(rec.get("name"), str)}
+    # The supervisor heartbeat contributes the autoscaler's decision
+    # counters and target gauge to the fleet fold — it has no metric
+    # shard of its own (it polishes nothing), so its metrics ride the
+    # heartbeat instead.
+    supervisor = load_supervisor(root)
+    if supervisor is not None:
+        for key, val in sorted(
+                (supervisor.get("metrics") or {}).items()):
+            if isinstance(val, (int, float)) and \
+                    not isinstance(val, bool):
+                fleet[key] = val
     return {
         "run_fp": fps[0],
         "n_workers": len(workers),
         "workers": workers,
         "fleet": fleet,
         "timeline": timeline,
+        "lineage": lineage,
         "steals": steals,
+        "splits": splits,
+        "spawns": spawns,
+        "retires": retires,
+        "supervisor": supervisor,
         "stragglers": stragglers,
     }

@@ -515,6 +515,10 @@ _MERGE_LAST_KEYS = frozenset({
     "sched_rounds_hist", "sched_survivor_frac",
     "sched_rounds_saved_frac", "sched_repack_overhead_s",
     "sched_dispatches_saved",
+    # The autoscaler's target-size gauge (distributed/autoscaler.py):
+    # folded into the fleet model from the supervisor heartbeat, never
+    # summed across workers.
+    "fleet_target_workers",
 })
 
 

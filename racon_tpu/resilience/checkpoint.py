@@ -100,10 +100,15 @@ def run_fingerprint(config: Dict, paths: Iterable[str]) -> str:
     return hashlib.sha256(blob.encode()).hexdigest()
 
 
-def shard_fingerprint(run_fp: str, shard: int) -> str:
+def shard_fingerprint(run_fp: str, shard) -> str:
     """Fingerprint of one work-ledger shard: the run identity plus the
-    shard id, so per-shard stores are mutually unspliceable."""
-    return hashlib.sha256(f"{run_fp}:shard:{int(shard)}"
+    shard key, so per-shard stores are mutually unspliceable. Base
+    shards key by partition index (int); dynamically split child
+    shards key by their lineage name suffix (str, e.g. "1s1_1"), so a
+    parent store can never be adopted as its child's even though their
+    target ranges are adjacent."""
+    key = int(shard) if not isinstance(shard, str) else shard
+    return hashlib.sha256(f"{run_fp}:shard:{key}"
                           .encode()).hexdigest()
 
 

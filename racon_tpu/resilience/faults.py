@@ -38,7 +38,10 @@ Site names match the transfer labels in obs (``h2d/chunk``,
 ``ckpt/commit``, ``ckpt/manifest`` (between the checkpoint's shard and
 manifest appends — the mid-commit eviction window), and the distributed
 worker's eviction points ``dist/claim`` / ``dist/shard`` /
-``dist/contig`` / ``dist/merge`` (racon_tpu/distributed/). Call indices
+``dist/contig`` / ``dist/merge`` and the split-publication window
+``dist/split`` (a ``torn`` there leaves a half-written child .range
+that every reader must treat as "no split happened";
+racon_tpu/distributed/). Call indices
 are 0-based and advance once per *attempt* at that site (each retry
 re-consults the injector), so ``site:0,1`` verifies genuine two-failure
 recovery.

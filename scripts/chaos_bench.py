@@ -1,0 +1,392 @@
+"""Chaos bench: an elastic fleet under seeded evictions and stragglers
+must finish within a bounded factor of the no-fault makespan, with the
+merged FASTA byte-identical to a serial run.
+
+This is the certification drill for the autoscaling supervisor
+(racon_tpu/distributed/autoscaler.py) on top of the work ledger's
+lease-steal + split machinery:
+
+- the supervisor runs as a real subprocess (``--autoscale``) and
+  spawns its own worker subprocesses against one ``--ledger-dir``;
+- a seeded fault plan (``RACON_TPU_AUTOSCALE_FAULT_PLAN``) assigns
+  injected faults to spawn ordinals: a hard kill at shard claim
+  (``dist/shard:0!kill``), a SIGTERM mid-commit (``!term`` — the
+  worker's signal path releases its lease, so reclaim is instant),
+  a mid-shard kill, and a straggler (``dist/shard:0!stall=S``) —
+  every run replays the same chaos;
+- gates: supervisor exit 0; its stdout AND the ledger's out.fasta
+  byte-identical to the serial baseline; the heartbeat shows the
+  fleet was held at target (initial spawns + one replacement per
+  eviction, every eviction classified); makespan <= ``--factor`` x
+  the NO-FAULT FLEET baseline + ``--slack``.
+
+The baseline for the factor is a fleet run of the same shape with no
+fault plan — that isolates what the chaos actually costs (lease-expiry
+waits and respawns) from what the fleet costs anyway (per-claim
+polisher builds, merge barrier). The slack term absorbs per-respawn
+constant costs (each replacement pays the interpreter + jax import
+again — seconds that at smoke scale would swamp a multiplicative
+bound) plus one lease-expiry wait for the mid-shard kill; the factor
+certifies the algorithmic claim that evictions cost bounded rework,
+not lost shards.
+
+``--monster`` runs the dynamic shard-split drill instead: one shard
+ending in a contig ~12x the others, held by a *degraded* worker (an
+injected 2s stall at every contig commit — the slow-disk straggler),
+versus the same fleet with ``RACON_TPU_SPLIT=0``. The holder stalls
+at the claim fault site long enough for the healthy second worker to
+join starved, so the claim-time trigger fires deterministically: the
+degraded holder keeps only the in-flight first contig and donates the
+entire un-committed tail — monster included — as a child shard the
+healthy worker claims and finishes at full speed. Without the split,
+every tail contig pays the degraded holder's per-commit stall. Gates:
+>= 1 split event published, byte-identical output both ways, and the
+split run measurably faster than the no-split run (the margin is
+~tail_size x the per-commit degradation, deterministic even on a
+single-core host).
+
+``--smoke`` shrinks the chaos run (3 workers, 2 evictions + 1
+straggler) for CI; the default is the full 4-worker / 3-eviction
+certification.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np                                   # noqa: E402
+
+BASES = np.frombuffer(b"ACGT", np.uint8)
+BOOT = "import sys; from racon_tpu import cli; sys.exit(cli.main(sys.argv[1:]))"
+
+#: Shard lease for every fleet run here. Must outlast a polisher build
+#: under full fleet load (the lease renews per contig commit, and the
+#: first renewal comes only after initialize + the first consensus) or
+#: fresh claims get spuriously stolen into a re-init ping-pong.
+LEASE_S = 30.0
+
+
+def _noisy(rng, truth):
+    out = []
+    for b in truth:
+        r = rng.random()
+        if r < 0.03:
+            continue
+        out.append(int(rng.integers(0, 4)) if r < 0.06 else int(
+            np.searchsorted(BASES, b)))
+    return bytes(BASES[np.array(out)])
+
+
+def _write_inputs(d, lengths, seed=11):
+    rng = np.random.default_rng(seed)
+    drafts, reads, paf = [], [], []
+    for c, n in enumerate(lengths):
+        truth = BASES[rng.integers(0, 4, n)]
+        draft = _noisy(rng, truth)
+        drafts.append(b">c%d\n%s\n" % (c, draft))
+        for i in range(6):
+            r = _noisy(rng, truth)
+            rid = f"r{c}_{i}"
+            reads.append(b">%s\n%s\n" % (rid.encode(), r))
+            paf.append(f"{rid}\t{len(r)}\t0\t{len(r)}\t+\tc{c}"
+                       f"\t{len(draft)}\t0\t{len(draft)}"
+                       f"\t{min(len(r), len(draft))}"
+                       f"\t{max(len(r), len(draft))}\t60")
+    with open(os.path.join(d, "draft.fasta"), "wb") as fh:
+        fh.write(b"".join(drafts))
+    with open(os.path.join(d, "reads.fasta"), "wb") as fh:
+        fh.write(b"".join(reads))
+    with open(os.path.join(d, "ovl.paf"), "w") as fh:
+        fh.write("\n".join(paf) + "\n")
+
+
+def _cmd(d, *extra):
+    return [sys.executable, "-c", BOOT, "--backend", "jax", *extra,
+            os.path.join(d, "reads.fasta"), os.path.join(d, "ovl.paf"),
+            os.path.join(d, "draft.fasta")]
+
+
+def _env(**overrides):
+    e = dict(os.environ)
+    for k in ("RACON_TPU_FAULTS", "RACON_TPU_TRACE",
+              "RACON_TPU_OBS_DIR", "RACON_TPU_OBS_FLUSH_S",
+              "RACON_TPU_DIST_AVOID", "RACON_TPU_DIST_SHARDS",
+              "RACON_TPU_SPLIT", "RACON_TPU_SPLIT_AFTER_S",
+              "RACON_TPU_METRICS_PORT"):
+        e.pop(k, None)
+    for k in list(e):
+        if k.startswith("RACON_TPU_AUTOSCALE_"):
+            e.pop(k)
+    e.update(overrides)
+    return e
+
+
+def _serial(d):
+    t0 = time.monotonic()
+    proc = subprocess.run(_cmd(d), capture_output=True, env=_env())
+    wall = time.monotonic() - t0
+    assert proc.returncode == 0, proc.stderr.decode()
+    return proc.stdout, wall
+
+
+def _split_events(ledger):
+    path = os.path.join(ledger, "events.jsonl")
+    if not os.path.exists(path):
+        return []
+    out = []
+    for line in open(path, "rb").read().splitlines():
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue  # torn tail
+        if rec.get("ev") == "split":
+            out.append(rec)
+    return out
+
+
+def _heartbeat(ledger):
+    path = os.path.join(ledger, "obs", "autoscaler.json")
+    with open(path, "r", encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+# ---------------------------------------------------------------- chaos
+def _fleet(d, ledger, n_workers, shards, timeout, plan=None):
+    """One supervised fleet run; returns (stdout, wall_seconds)."""
+    env = {
+        "RACON_TPU_DIST_SHARDS": str(shards),
+        "RACON_TPU_OBS_FLUSH_S": "0",
+        "RACON_TPU_AUTOSCALE_MIN": str(n_workers),
+        "RACON_TPU_AUTOSCALE_MAX": str(n_workers),
+        "RACON_TPU_AUTOSCALE_INTERVAL_S": "0.2",
+        "RACON_TPU_AUTOSCALE_DEADLINE_S": str(timeout),
+    }
+    if plan is not None:
+        plan_path = ledger + ".fault_plan.json"
+        with open(plan_path, "w", encoding="utf-8") as fh:
+            json.dump(plan, fh)
+        env["RACON_TPU_AUTOSCALE_FAULT_PLAN"] = plan_path
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        _cmd(d, "--ledger-dir", ledger, "--workers", str(n_workers),
+             "--lease-s", str(LEASE_S), "--autoscale"),
+        capture_output=True, env=_env(**env), timeout=timeout + 60)
+    wall = time.monotonic() - t0
+    assert proc.returncode == 0, \
+        f"supervisor exit {proc.returncode}:\n{proc.stderr.decode()}"
+    return proc.stdout, wall
+
+
+def run_chaos(args):
+    if args.smoke:
+        n_workers, lengths, shards = 3, [300 + 30 * c for c in range(6)], 3
+        # Spawn-ordinal fault plan: 2 evictions + 1 straggler.
+        plan = ["dist/shard:0!kill",       # as0: killed at shard claim
+                "ckpt/manifest:0!term",    # as1: SIGTERM mid-commit
+                "dist/shard:0!stall=2"]    # as2: straggles, survives
+        n_evict = 2
+    else:
+        n_workers, lengths, shards = 4, [300 + 30 * c for c in range(8)], 4
+        plan = ["dist/shard:0!kill",       # as0: killed at shard claim
+                "ckpt/manifest:0!term",    # as1: SIGTERM mid-commit
+                "dist/contig:1!kill",      # as2: killed mid-shard
+                "dist/shard:0!stall=3"]    # as3: the straggler
+        n_evict = 3
+    # Replacements (ordinals beyond the plan) run clean.
+
+    with tempfile.TemporaryDirectory() as d:
+        _write_inputs(d, lengths)
+        base, t_serial = _serial(d)
+        assert base.count(b">") == len(lengths)
+        print(f"[chaos-bench] serial baseline: {t_serial:.1f}s, "
+              f"{len(base)} bytes", flush=True)
+
+        # No-fault fleet baseline: same supervisor, same shape, no
+        # fault plan — the denominator of the makespan guarantee.
+        out0, t_fleet = _fleet(d, os.path.join(d, "ledger0"),
+                               n_workers, shards, args.timeout)
+        assert out0 == base, \
+            "no-fault fleet stdout differs from the serial run"
+        print(f"[chaos-bench] no-fault fleet of {n_workers}: "
+              f"{t_fleet:.1f}s", flush=True)
+
+        ledger = os.path.join(d, "ledger")
+        out1, t_chaos = _fleet(d, ledger, n_workers, shards,
+                               args.timeout, plan=plan)
+
+        # Byte identity: supervisor stdout AND the published merge.
+        assert out1 == base, \
+            "chaos fleet stdout differs from the serial run"
+        assert open(os.path.join(ledger, "out.fasta"),
+                    "rb").read() == base
+        print(f"[chaos-bench] chaos fleet under {n_evict} eviction(s) "
+              f"+ 1 straggler: {t_chaos:.1f}s, merged FASTA "
+              "byte-identical to serial", flush=True)
+
+        # The autoscaler held the fleet at target: initial spawns plus
+        # one replacement per injected eviction, all recorded in the
+        # final heartbeat, and every eviction classified.
+        hb = _heartbeat(ledger)
+        assert hb["done"] is True, hb
+        assert hb["spawned_total"] >= n_workers + n_evict, hb
+        assert hb["scale_up_total"] >= n_workers, hb
+        evicted = hb["evicted_total"] + hb["self_evicted_total"]
+        assert evicted >= n_evict, hb
+        assert hb["workers_done"] >= 1, hb
+
+        # The makespan guarantee: bounded factor of the no-fault fleet
+        # run, plus additive slack for respawn startup + one mid-shard
+        # lease expiry.
+        bound = args.factor * t_fleet + args.slack
+        assert t_chaos <= bound, \
+            (f"chaos makespan {t_chaos:.1f}s exceeds bound "
+             f"{bound:.1f}s ({args.factor} x {t_fleet:.1f}s no-fault "
+             f"fleet + {args.slack:.0f}s slack)")
+        print(f"[chaos-bench] makespan {t_chaos:.1f}s <= bound "
+              f"{bound:.1f}s; heartbeat: {hb['spawned_total']} "
+              f"spawn(s), {evicted} evicted, "
+              f"{hb['workers_done']} done", flush=True)
+    print("[chaos-bench] PASS", flush=True)
+
+
+# -------------------------------------------------------------- monster
+#: The degraded holder's lease. Its renewal gap spans the claim
+#: stall + polisher build + all consensus compute + the first commit
+#: stall (renewal is per-commit), and this drill certifies the split
+#: path, not lease stealing — so keep the lease far above that gap.
+MONSTER_LEASE_S = 120.0
+
+#: The holder's per-commit degradation (a slow-disk straggler: every
+#: contig commit stalls this long). The no-split run pays it for the
+#: whole tail; the split run pays it once, on the kept first contig.
+MONSTER_DEGRADE_S = 2.0
+
+
+def _monster_fleet(d, ledger, *, split_on, timeout):
+    """Two plain workers against one single-shard ledger whose last
+    contig is the monster. Worker A — the *degraded* worker, stalling
+    MONSTER_DEGRADE_S at every contig commit — claims the (only)
+    shard and stalls at the claim fault site; worker B joins during
+    the stall, so A's claim-time split trigger (armed immediately:
+    SPLIT_AFTER_S=0) sees a starved live worker and donates the
+    entire un-committed tail — monster included — keeping only the
+    in-flight first contig. Healthy B claims the child and polishes
+    the tail commit-stall-free. With RACON_TPU_SPLIT=0 the degraded
+    A keeps everything and pays the per-commit stall for the whole
+    tail while B just idles, so the makespan gap is ~tail_size x
+    MONSTER_DEGRADE_S — independent of compute overlap, hence
+    deterministic even on a single-core CI host."""
+    env_common = {
+        "RACON_TPU_DIST_SHARDS": "1",
+        "RACON_TPU_OBS_FLUSH_S": "0",
+        "RACON_TPU_SPLIT": "1" if split_on else "0",
+        "RACON_TPU_SPLIT_AFTER_S": "0",
+    }
+    faults = (f"dist/shard:0!stall=8;"
+              f"dist/contig:p=1.0!stall={MONSTER_DEGRADE_S:g}")
+    t0 = time.monotonic()
+    a = subprocess.Popen(
+        _cmd(d, "--ledger-dir", ledger, "--workers", "2",
+             "--worker-id", "A", "--lease-s", str(MONSTER_LEASE_S)),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        env=_env(**env_common, RACON_TPU_FAULTS=faults))
+    # A must be the claimer: wait for its lease before starting B. The
+    # 8s stall then covers B's interpreter + jax import comfortably,
+    # so B has joined (live metric shard, zero leases) by the time A
+    # evaluates the split trigger.
+    deadline = time.monotonic() + 120
+    while not os.path.exists(os.path.join(ledger, "shard_0.lease")):
+        assert time.monotonic() < deadline, "worker A never claimed"
+        assert a.poll() is None, a.communicate()[1].decode()
+        time.sleep(0.05)
+    b = subprocess.Popen(
+        _cmd(d, "--ledger-dir", ledger, "--workers", "2",
+             "--worker-id", "B", "--lease-s", str(MONSTER_LEASE_S)),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        env=_env(**env_common,
+                 RACON_TPU_FAULTS="dist/shard:0!stall=12"))
+    a_out, a_err = a.communicate(timeout=timeout)
+    b_out, b_err = b.communicate(timeout=timeout)
+    wall = time.monotonic() - t0
+    assert a.returncode == 0, a_err.decode()
+    assert b.returncode == 0, b_err.decode()
+    outs = [o for o in (a_out, b_out) if o]
+    assert len(outs) == 1, "exactly one worker must emit the merge"
+    return outs[0], wall
+
+
+def run_monster(args):
+    # A tail of smalls capped by one monster contig (~12x the window
+    # count of a small): the split run hands the whole tail to the
+    # healthy worker B; the no-split run commits it all through the
+    # degraded holder, paying MONSTER_DEGRADE_S per contig. The tail
+    # width sets the expected margin (~22 x 2s) well clear of
+    # compile-cache and load noise.
+    lengths = [600] * 22 + [12000]
+    with tempfile.TemporaryDirectory() as d:
+        _write_inputs(d, lengths, seed=23)
+        base, t_serial = _serial(d)
+        assert base.count(b">") == len(lengths)
+        print(f"[chaos-bench] monster drill serial baseline: "
+              f"{t_serial:.1f}s", flush=True)
+
+        led_split = os.path.join(d, "ledger_split")
+        out_split, t_split = _monster_fleet(
+            d, led_split, split_on=True, timeout=args.timeout)
+        splits = _split_events(led_split)
+        assert splits, "split run published no split event"
+        assert out_split == base, \
+            "split-run merged FASTA differs from serial"
+        child = splits[0]["child"]
+        assert os.path.exists(os.path.join(led_split,
+                                           f"{child}.range"))
+
+        led_flat = os.path.join(d, "ledger_nosplit")
+        out_flat, t_flat = _monster_fleet(
+            d, led_flat, split_on=False, timeout=args.timeout)
+        assert not _split_events(led_flat), \
+            "RACON_TPU_SPLIT=0 must suppress splitting"
+        assert out_flat == base, \
+            "no-split merged FASTA differs from serial"
+
+        print(f"[chaos-bench] monster drill: split {t_split:.1f}s "
+              f"({len(splits)} split event(s), child {child}) vs "
+              f"no-split {t_flat:.1f}s", flush=True)
+        assert t_split < t_flat, \
+            (f"dynamic split did not shorten the makespan: "
+             f"{t_split:.1f}s vs {t_flat:.1f}s")
+    print("[chaos-bench] PASS", flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--smoke", action="store_true",
+                    help="small CI variant: 3 workers, 2 evictions + "
+                         "1 straggler")
+    ap.add_argument("--monster", action="store_true",
+                    help="dynamic shard-split drill instead of the "
+                         "eviction chaos run")
+    ap.add_argument("--factor", type=float, default=1.5,
+                    help="multiplicative makespan bound vs the "
+                         "no-fault fleet baseline (default 1.5)")
+    ap.add_argument("--slack", type=float, default=25.0,
+                    help="additive makespan slack in seconds, "
+                         "absorbing per-respawn startup costs and one "
+                         "mid-shard lease expiry (default 25)")
+    ap.add_argument("--timeout", type=float, default=420.0,
+                    help="hard deadline per fleet run (default 420s)")
+    args = ap.parse_args()
+    if args.monster:
+        run_monster(args)
+    else:
+        run_chaos(args)
+
+
+if __name__ == "__main__":
+    main()

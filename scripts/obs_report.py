@@ -392,9 +392,11 @@ def _render_dist(m, by_kind, out) -> None:
 def _render_fleet(fleet_dir: str, out) -> None:
     """The "Fleet" section (``--fleet LEDGER_DIR``): the cross-worker
     view from the worker metric shards + events.jsonl — per-worker
-    rates (stragglers flagged), merged counters, and the per-shard
-    lease timeline (claim/renew/steal/release/complete, renew runs
-    compressed). Mixed-run
+    rates (stragglers flagged), merged counters, the autoscaler's
+    supervisor heartbeat when one attached, and the per-shard lease
+    timeline (claim/renew/steal/release/complete plus
+    ``split->child`` markers, renew runs compressed; split-child
+    lanes lead with their ancestry chain). Mixed-run
     shard directories raise FleetObsError in the aggregator; main()
     turns that into a clear exit-1 error."""
     import os
@@ -402,9 +404,24 @@ def _render_fleet(fleet_dir: str, out) -> None:
         os.path.abspath(__file__))))
     from racon_tpu.obs.fleet import aggregate
     model = aggregate(fleet_dir)
+    elastic = ""
+    if model.get("splits") or model.get("spawns") or \
+            model.get("retires"):
+        elastic = (f"  splits={model.get('splits', 0)}  "
+                   f"spawns={model.get('spawns', 0)}  "
+                   f"retires={model.get('retires', 0)}")
     print(f"\nfleet: workers={model['n_workers']}  "
-          f"steals={model['steals']}  "
+          f"steals={model['steals']}{elastic}  "
           f"run_fp={model['run_fp'][:12]}", file=out)
+    sup = model.get("supervisor")
+    if sup:
+        done = "done" if sup.get("done") else "running"
+        print(f"  supervisor: target={sup.get('target_workers', '?')}  "
+              f"live={sup.get('live_workers', '?')}  "
+              f"spawned={sup.get('spawned_total', '?')}  "
+              f"retired={sup.get('workers_retired', 0)}  "
+              f"evicted={sup.get('workers_evicted', 0)}  "
+              f"[{done}]", file=out)
     print(f"  {'worker':>16}  {'windows/s':>9}  {'wall_s':>8}  "
           f"{'final':>5}  {'snapshots':>9}", file=out)
     for wid in sorted(model["workers"]):
@@ -429,6 +446,7 @@ def _render_fleet(fleet_dir: str, out) -> None:
               "  (windows/s below the fleet-median fraction, "
               "obs/fleet.py)", file=out)
     timeline = model.get("timeline", {})
+    lineage = model.get("lineage") or {}
     if timeline:
         print("  lease timeline:", file=out)
         t_base = min((e["t"] for lane in timeline.values()
@@ -447,9 +465,22 @@ def _render_fleet(fleet_dir: str, out) -> None:
                     parts.append(
                         f"steal [{e['worker']}<-{e.get('victim')}] "
                         f"{at}")
+                elif e["ev"] == "split":
+                    parts.append(f"split->{e.get('child')} "
+                                 f"[{e['worker']}] {at}")
                 else:
                     parts.append(f"{e['ev']} [{e['worker']}] {at}")
-            print(f"    {name}: " + " -> ".join(parts), file=out)
+            # A split child's lane leads with its full ancestry so the
+            # reader can trace every donated range back to its seed
+            # shard without cross-referencing lanes.
+            chain, seen = [], set()
+            parent = lineage.get(name)
+            while parent is not None and parent not in seen:
+                seen.add(parent)
+                chain.append(parent)
+                parent = lineage.get(parent)
+            anc = (" (< " + " < ".join(chain) + ")") if chain else ""
+            print(f"    {name}{anc}: " + " -> ".join(parts), file=out)
 
 
 def _render_redo(m, out) -> None:

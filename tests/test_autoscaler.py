@@ -1,0 +1,189 @@
+"""Elastic-fleet supervisor tests: the autoscale policy, worker argv
+derivation, fault-plan loading, the heartbeat record, and the /healthz
+fleet view (racon_tpu/distributed/autoscaler.py, obs/export.py,
+docs/DISTRIBUTED.md "Elastic fleets").
+
+The control loop's end-to-end behaviour (spawn/retire/replace real
+subprocesses, makespan bound, byte-identical merge) is the multi-
+process drill scripts/chaos_bench.py --smoke, wired into ci.sh.
+"""
+
+import io
+import json
+import os
+import time
+
+import pytest
+
+from racon_tpu.distributed import autoscaler as asc
+from racon_tpu.distributed.ledger import LedgerError
+from racon_tpu.obs import export as obs_export
+from racon_tpu.obs import fleet as obs_fleet
+
+
+@pytest.fixture(autouse=True)
+def autoscale_sandbox(monkeypatch):
+    for env in (asc.ENV_MIN, asc.ENV_MAX, asc.ENV_INTERVAL,
+                asc.ENV_MAX_SPAWNS, asc.ENV_DEADLINE,
+                asc.ENV_FAULT_PLAN):
+        monkeypatch.delenv(env, raising=False)
+    yield
+
+
+# --------------------------------------------------------------- policy
+
+
+def test_policy_defaults_and_env(monkeypatch):
+    pol = asc.AutoscalePolicy.from_env(default_max=4)
+    assert (pol.min_workers, pol.max_workers) == (1, 4)
+    assert pol.interval_s == 0.5
+    assert pol.max_spawns == 16           # max(8, 4 * MAX)
+    assert pol.deadline_s == 0.0          # no deadline
+    monkeypatch.setenv(asc.ENV_MIN, "2")
+    monkeypatch.setenv(asc.ENV_MAX, "6")
+    monkeypatch.setenv(asc.ENV_INTERVAL, "0.01")  # clamped to 0.05
+    monkeypatch.setenv(asc.ENV_MAX_SPAWNS, "40")
+    monkeypatch.setenv(asc.ENV_DEADLINE, "120")
+    pol = asc.AutoscalePolicy.from_env(default_max=4)
+    assert (pol.min_workers, pol.max_workers) == (2, 6)
+    assert pol.interval_s == 0.05
+    assert (pol.max_spawns, pol.deadline_s) == (40, 120.0)
+    monkeypatch.setenv(asc.ENV_MAX, "oops")
+    with pytest.raises(LedgerError, match="not a number"):
+        asc.AutoscalePolicy.from_env(default_max=4)
+    monkeypatch.setenv(asc.ENV_MAX, "1")
+    monkeypatch.setenv(asc.ENV_MIN, "5")
+    with pytest.raises(LedgerError, match="MIN 5 > MAX 1"):
+        asc.AutoscalePolicy.from_env(default_max=4)
+
+
+def test_decide_clamps_to_open_work():
+    pol = asc.AutoscalePolicy(1, 4, 0.5, 16, 0.0)
+    # Meta unpublished: spawn at MAX optimistically.
+    assert asc.decide(None, pol) == 4
+    assert asc.decide(0, pol) == 1        # MIN floor (merge pending)
+    assert asc.decide(2, pol) == 2
+    assert asc.decide(9, pol) == 4        # MAX ceiling
+
+
+def test_worker_argv_strips_supervisor_flags():
+    raw = ["--backend", "jax", "--autoscale", "--worker-id", "sup",
+           "--ledger-dir", "L", "--worker-id=sup2", "reads.fa"]
+    assert asc.worker_argv(raw) == ["--backend", "jax",
+                                    "--ledger-dir", "L", "reads.fa"]
+
+
+def test_fault_plan_loads_and_validates(tmp_path, monkeypatch):
+    log = io.StringIO()
+    assert asc._load_fault_plan(log) == []    # no plan: all clean
+    path = tmp_path / "plan.json"
+    path.write_text(json.dumps(["dist/shard:0!kill", "", "skew=1"]))
+    monkeypatch.setenv(asc.ENV_FAULT_PLAN, str(path))
+    assert asc._load_fault_plan(log) == ["dist/shard:0!kill", "",
+                                         "skew=1"]
+    assert "2 faulted spawn(s) of 3" in log.getvalue()
+    path.write_text(json.dumps({"not": "a list"}))
+    with pytest.raises(LedgerError, match="JSON list"):
+        asc._load_fault_plan(log)
+    monkeypatch.setenv(asc.ENV_FAULT_PLAN, str(tmp_path / "missing"))
+    with pytest.raises(LedgerError, match="unreadable fault plan"):
+        asc._load_fault_plan(log)
+
+
+# ------------------------------------------------------------ heartbeat
+
+
+def _scaler(tmp_path):
+    return asc.Autoscaler(str(tmp_path / "ledger"), ["--backend",
+                          "jax"], policy=asc.AutoscalePolicy(
+                              1, 2, 0.1, 8, 0.0),
+                          out=io.BytesIO(), log=io.StringIO())
+
+
+def test_heartbeat_record_round_trips(tmp_path):
+    sc = _scaler(tmp_path)
+    sc.counters["scale_up_total"] = 3
+    sc.counters["evicted_total"] = 1
+    sc.counters["self_evicted_total"] = 1
+    sc._heartbeat(target=2, open_work=5, done=False)
+    hb = obs_fleet.load_supervisor(sc.ledger_dir)
+    assert hb is not None and hb["schema"] == 1
+    assert hb["target_workers"] == 2 and hb["open_shards"] == 5
+    assert hb["done"] is False and hb["seq"] == 0
+    assert hb["workers_evicted"] == 2     # evicted + self-evicted
+    # The supervisor's metric facts ride the heartbeat (it has no
+    # metric shard of its own) under fleet merge-kind names.
+    assert hb["metrics"] == {"dist_scale_up_total": 3,
+                             "dist_scale_down_total": 0,
+                             "fleet_target_workers": 2}
+    sc._heartbeat(target=0, open_work=0, done=True)
+    hb = obs_fleet.load_supervisor(sc.ledger_dir)
+    assert hb["seq"] == 1 and hb["done"] is True
+
+
+# --------------------------------------------------------- fleet health
+
+
+def _write_heartbeat(ledger_dir, age_s=0.0, interval_s=0.5,
+                     done=False):
+    d = obs_fleet.obs_dir_for(ledger_dir)
+    os.makedirs(d, exist_ok=True)
+    rec = {"schema": 1, "unix_time": time.time() - age_s,
+           "interval_s": interval_s, "target_workers": 2,
+           "live_workers": 2, "done": done, "workers_live": 2,
+           "workers_evicted": 1, "workers_retired": 0,
+           "workers_done": 0}
+    with open(os.path.join(d, obs_fleet.SUPERVISOR_NAME), "w") as fh:
+        fh.write(json.dumps(rec))
+
+
+def test_fleet_health_view_and_supervisor_staleness(tmp_path):
+    ld = str(tmp_path / "ledger")
+    os.makedirs(ld)
+    # No supervisor ever ran: not penalized, ledger meta unpublished.
+    snap = obs_export.fleet_health(ld)
+    assert snap["status"] == "ok"
+    assert snap["fleet"]["open_shards"] is None
+    assert "autoscaler" not in snap["fleet"]
+    # Fresh heartbeat: ok, and the decision facts are surfaced.
+    _write_heartbeat(ld, age_s=0.0)
+    snap = obs_export.fleet_health(ld)
+    assert snap["status"] == "ok"
+    assert snap["fleet"]["autoscaler"]["target_workers"] == 2
+    assert snap["fleet"]["workers_evicted"] == 1
+    # Stale heartbeat mid-run: supervisor-dead — the probes' 503.
+    _write_heartbeat(ld, age_s=60.0, interval_s=0.5)
+    snap = obs_export.fleet_health(ld)
+    assert snap["status"] == "supervisor-dead"
+    assert snap["fleet"]["autoscaler"]["age_s"] >= 59.0
+    # A stale heartbeat that says done is a finished fleet, not a dead
+    # one.
+    _write_heartbeat(ld, age_s=60.0, done=True)
+    assert obs_export.fleet_health(ld)["status"] == "ok"
+
+
+def test_fleet_health_served_as_503(tmp_path):
+    """End-to-end probe contract: the /healthz endpoint returns 503
+    for a supervisor-dead fleet so a stock HTTP liveness probe can
+    evict it."""
+    import urllib.error
+    import urllib.request
+
+    ld = str(tmp_path / "ledger")
+    os.makedirs(ld)
+    _write_heartbeat(ld, age_s=60.0, interval_s=0.5)
+    srv = obs_export.serve_metrics(
+        0, lambda: "# EOF\n",
+        health=lambda: obs_export.fleet_health(ld))
+    try:
+        url = "http://127.0.0.1:%d/healthz" % srv.server_address[1]
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(url)
+        assert exc.value.code == 503
+        assert json.loads(exc.value.read())["status"] == \
+            "supervisor-dead"
+        _write_heartbeat(ld, age_s=0.0)
+        with urllib.request.urlopen(url) as resp:
+            assert resp.status == 200
+    finally:
+        srv.shutdown()

@@ -315,3 +315,127 @@ def test_eviction_steal_resume_byte_identity(tmp_path):
 def _ledger_fp(ld):
     with open(os.path.join(ld, dledger.META_NAME)) as fh:
         return json.load(fh)["fingerprint"]
+
+# --------------------------------------------------------------- split
+
+
+def test_split_publishes_child_and_shrinks_parent(tmp_path):
+    led = WorkLedger.open(str(tmp_path / "l"), "fp", n_targets=6,
+                          workers=1, n_shards=1)
+    a = led.claim_shard("A")
+    child = led.split(a, 2)
+    assert child is not None
+    assert (child.start, child.end) == (2, 6)
+    assert child.parent == "shard_0" and child.root == 0
+    assert a.info.end == 2
+    # The effective ranges still tile [0, 6).
+    infos = {i.name: (i.start, i.end) for i in led.all_shards()}
+    assert infos["shard_0"] == (0, 2)
+    assert infos[child.name] == (2, 6)
+    assert sorted(led.pending_shards()) == sorted(
+        ["shard_0", child.name])
+    assert dledger.split_depth(child.name) == 1
+    # Any idle worker claims the child immediately — fresh, not stolen.
+    b = led.claim_shard("B")
+    assert b is not None and b.name == child.name and not b.stolen
+    ev = [e for e in led.events() if e.get("ev") == "split"]
+    assert len(ev) == 1 and ev[0]["child"] == child.name
+    assert obs_metrics.registry().snapshot()["dist_splits_total"] == 1
+
+
+def test_split_guards(tmp_path):
+    led = WorkLedger.open(str(tmp_path / "l"), "fp", n_targets=4,
+                          workers=1, n_shards=1)
+    a = led.claim_shard("A")
+    for cut in (0, 4, 9):
+        with pytest.raises(LedgerError, match="outside the held"):
+            led.split(a, cut)
+    m = led.claim_merge("A")
+    with pytest.raises(LedgerError, match="only shard claims"):
+        led.split(m, 1)
+    # A stolen lease cannot split: the thief owns the full range now.
+    faults.configure("skew=9999")
+    b = led.claim_shard("B")
+    faults.configure(None)
+    assert b is not None and b.stolen
+    with pytest.raises(LeaseLost):
+        led.split(a, 2)
+    assert len(led.all_shards()) == 1  # nothing was published
+
+
+def test_torn_split_is_invisible(tmp_path, monkeypatch):
+    """The dist/split torn-write drill: a holder that dies mid-publish
+    leaves a truncated .range at the final path; readers must see no
+    child and the parent's full range — never a half-carved shard."""
+    class _Died(BaseException):
+        pass
+
+    monkeypatch.setattr(
+        dledger, "hard_exit",
+        lambda code: (_ for _ in ()).throw(_Died(code)))
+    led = WorkLedger.open(str(tmp_path / "l"), "fp", n_targets=4,
+                          workers=1, n_shards=1)
+    a = led.claim_shard("A")
+    faults.configure("dist/split:0!torn")
+    with pytest.raises(_Died):
+        led.split(a, 2)
+    faults.configure(None)
+    # The torn file is on disk but never becomes work.
+    assert any(fn.endswith(dledger.RANGE_SUFFIX)
+               for fn in os.listdir(str(tmp_path / "l")))
+    assert [(i.name, i.start, i.end) for i in led.all_shards()] == \
+        [("shard_0", 0, 4)]
+    assert led.pending_shards() == ["shard_0"]
+
+
+def test_release_is_fenced_and_hands_off_instantly(tmp_path):
+    """Regression for the release/steal race: release is a marker
+    rename, never an unlink, so a victim's late release cannot revoke
+    a thief's freshly won lease — and a live holder's release makes
+    the shard instantly claimable with a bumped epoch."""
+    led = WorkLedger.open(str(tmp_path / "l"), "fp", n_targets=4,
+                          workers=1, n_shards=1)
+    a = led.claim_shard("A")
+    faults.configure("skew=9999")
+    b = led.claim_shard("B")
+    faults.configure(None)
+    assert b is not None and b.stolen
+    led.release(a)          # stale nonce: silent no-op, B keeps it
+    led.renew(b)
+    child = led.split(b, 2)  # the split protocol survives too
+    assert child is not None
+    led.complete(b, n_committed=2)
+    # Cooperative handoff: release -> instant reclaim, epoch bumped,
+    # not counted as a steal.
+    c = led.claim_shard("C")
+    assert c is not None and c.name == child.name
+    led.release(c)
+    d = led.claim_shard("D")
+    assert d is not None and d.name == child.name
+    assert d.epoch == c.epoch + 1 and not d.stolen
+    ev = [e["ev"] for e in led.events()]
+    assert ev.count("release") == 1 and ev.count("steal") == 1
+
+
+def test_split_depth_cap_blocks_cascade(tmp_path, monkeypatch):
+    """Two workers trading a shrinking tail must not fragment it into
+    one-contig claims: every handoff costs the new holder a polisher
+    build, so at the default cap a split child never re-splits
+    (regression for the claim-time handoff cascade)."""
+    from racon_tpu.distributed import worker as dworker
+
+    monkeypatch.setenv(dworker.ENV_SPLIT_AFTER, "0")
+    monkeypatch.setattr(dworker, "_live_workers", lambda d: 99)
+    led = WorkLedger.open(str(tmp_path / "l"), "fp", n_targets=8,
+                          workers=1, n_shards=1)
+    log = io.StringIO()
+    a = led.claim_shard("A")
+    assert dworker._maybe_split(led, a, 1, 0.0, log)
+    assert a.info.end == 2  # kept [0, 2), donated [2, 8)
+    b = led.claim_shard("B")
+    assert b is not None and dledger.split_depth(b.name) == 1
+    # Same starvation signals, but the claim is a split child: refuse.
+    assert not dworker._maybe_split(led, b, b.info.start, 0.0, log)
+    # Raising the cap re-enables recursive splitting.
+    monkeypatch.setenv(dledger.ENV_SPLIT_DEPTH, "2")
+    assert dworker._maybe_split(led, b, b.info.start, 0.0, log)

@@ -441,3 +441,71 @@ def test_sigterm_leaves_final_snapshot(tmp_path, monkeypatch, capsys):
     assert last["worker_id"] == "W"
     assert last["final"], "SIGTERM teardown did not flush a final " \
                           "snapshot"
+
+# ---------------------------------------------------------- elastic fleet
+
+def test_aggregate_split_lineage_and_supervisor_fold(tmp_path):
+    """The elastic-fleet view: split/spawn/retire event counts, the
+    child->parent lineage map, split markers in the timeline, and the
+    supervisor heartbeat's metric facts folded into the fleet model."""
+    obs = tmp_path / obs_fleet.OBS_SUBDIR
+    obs.mkdir()
+    w, _ = _writer(obs, "A", "fp1")
+    w.flush(final=True)
+    events = [
+        {"ev": "spawn", "worker": "as0", "reason": "scale-up"},
+        {"ev": "claim", "name": "shard_0", "worker": "A", "t": 1.0},
+        {"ev": "split", "name": "shard_0", "child": "shard_0s1_1",
+         "worker": "A", "epoch": 1, "start": 2, "end": 6, "t": 2.0},
+        {"ev": "claim", "name": "shard_0s1_1", "worker": "B",
+         "t": 2.5},
+        {"ev": "split", "name": "shard_0s1_1",
+         "child": "shard_0s1_1s1_1", "worker": "B", "epoch": 1,
+         "start": 4, "end": 6, "t": 3.0},
+        {"ev": "retire", "worker": "as0", "reason": "scale-down"},
+    ]
+    with open(tmp_path / "events.jsonl", "w") as fh:
+        for e in events:
+            fh.write(json.dumps(e) + "\n")
+    hb = {"schema": 1, "unix_time": 12.0, "interval_s": 0.5,
+          "target_workers": 2, "live_workers": 2, "done": False,
+          "metrics": {"dist_scale_up_total": 3,
+                      "dist_scale_down_total": 1,
+                      "fleet_target_workers": 2,
+                      "bogus_non_numeric": "nope"}}
+    (obs / obs_fleet.SUPERVISOR_NAME).write_text(json.dumps(hb))
+    model = obs_fleet.aggregate(str(tmp_path))
+    assert model["splits"] == 2
+    assert model["spawns"] == 1 and model["retires"] == 1
+    assert model["lineage"] == {
+        "shard_0s1_1": "shard_0",
+        "shard_0s1_1s1_1": "shard_0s1_1"}
+    lane = model["timeline"]["shard_0"]
+    assert [e["ev"] for e in lane] == ["claim", "split"]
+    assert lane[1]["child"] == "shard_0s1_1"
+    assert model["supervisor"]["target_workers"] == 2
+    # Heartbeat metrics fold into the fleet numbers; non-numeric
+    # entries are dropped, never exported.
+    assert model["fleet"]["dist_scale_up_total"] == 3
+    assert model["fleet"]["dist_scale_down_total"] == 1
+    assert model["fleet"]["fleet_target_workers"] == 2
+    assert "bogus_non_numeric" not in model["fleet"]
+    # ...and render as valid, byte-stable OpenMetrics.
+    text = obs_export.render_fleet(model)
+    assert obs_export.validate_openmetrics(text) == []
+    assert "racon_tpu_dist_scale_up_total 3" in text
+    assert "racon_tpu_fleet_target_workers 2" in text
+    assert text == obs_export.render_fleet(
+        obs_fleet.aggregate(str(tmp_path)))
+
+
+def test_autoscale_merge_kinds():
+    """The supervisor's counters sum across restarts; the target size
+    is a point-in-time gauge and must take the last value."""
+    mk = obs_metrics.merge_kind
+    assert mk("dist_scale_up_total") == obs_metrics.MERGE_SUM
+    assert mk("dist_scale_down_total") == obs_metrics.MERGE_SUM
+    assert mk("dist_splits_total") == obs_metrics.MERGE_SUM
+    assert mk("fleet_target_workers") == obs_metrics.MERGE_LAST
+    assert obs_metrics.merge_values("fleet_target_workers",
+                                    [4, 2]) == 2
