@@ -86,6 +86,65 @@ def build_windows(n_windows: int, coverage: int, wlen: int, seed: int = 0):
     return windows
 
 
+def _ingest_bench() -> dict:
+    """Ingest micro-bench (metric_version 11): a multi-member gzipped
+    genome-like FASTA (1 MB contig lines — inflate-dominated, the
+    ROADMAP item 2 shape) parsed twice: RACON_TPU_INGEST=0 (serial
+    gzip.open reader) vs =1 (parallel member inflate, io/inflate.py),
+    records asserted identical. Publishes decompressed MB/s for both
+    and the speedup; the gated run's registry ingest_* accounting
+    (bytes, inflate/parse/wait seconds, fraction-of-wall) rides along
+    via ingest_extras. NOTE the speedup scales with physical cores —
+    member inflate parallelizes across a worker pool (zlib releases
+    the GIL), so a 1-core container reads ~1x here by construction."""
+    import gzip
+    import tempfile
+    from racon_tpu.io.parsers import CHUNK_SIZE, create_sequence_parser
+    from racon_tpu.obs import metrics as obs_metrics
+    from racon_tpu.pipeline.streaming import serial_chunks
+
+    rng = np.random.default_rng(12)
+    line = rng.choice(np.frombuffer(b"ACGT", np.uint8),
+                      size=1 << 20).tobytes()
+    n_members = int(os.environ.get("RACON_TPU_BENCH_INGEST_MB", "16"))
+    gate0 = os.environ.get("RACON_TPU_INGEST", "")
+    out: dict = {}
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "ingest_bench.fasta.gz")
+        with open(path, "wb") as fh:
+            for i in range(n_members):        # one member per contig
+                fh.write(gzip.compress(b">c%d\n%s\n" % (i, line),
+                                       compresslevel=1))
+        raw_mb = n_members * (len(line) + 8) / 1e6
+        try:
+            os.environ["RACON_TPU_INGEST"] = "0"
+            t0 = time.perf_counter()
+            serial_recs = create_sequence_parser(path).parse_all()
+            dt_serial = time.perf_counter() - t0
+            os.environ["RACON_TPU_INGEST"] = "1"
+            parser = create_sequence_parser(path)
+            par_recs = []
+            t0 = time.perf_counter()
+            for chunk, _more in serial_chunks(parser, CHUNK_SIZE):
+                par_recs.extend(chunk)
+            dt_par = time.perf_counter() - t0
+        finally:
+            if gate0:
+                os.environ["RACON_TPU_INGEST"] = gate0
+            else:
+                os.environ.pop("RACON_TPU_INGEST", None)
+    assert [(s.name, bytes(s.data)) for s in par_recs] == \
+        [(s.name, bytes(s.data)) for s in serial_recs], \
+        "parallel ingest diverged from serial reader"
+    obs_metrics.set_ingest_fraction(dt_par)
+    out["ingest_mb_per_sec"] = round(raw_mb / dt_par, 2)
+    out["ingest_serial_mb_per_sec"] = round(raw_mb / dt_serial, 2)
+    out["ingest_speedup_vs_serial"] = round(dt_serial / dt_par, 2)
+    out["ingest_seconds"] = round(dt_par, 4)
+    out["ingest_bench_mb"] = round(raw_mb, 1)
+    return out
+
+
 def main():
     from racon_tpu.utils.jaxcache import enable_compile_cache
     enable_compile_cache()
@@ -272,12 +331,25 @@ def main():
             "keys — re-run scripts/dp_scaling_bench.py --out"
         dp_extras = {k: v for k, v in dp_extras.items()
                      if k.startswith("dp_")}
+    ingest_bench_extras = _ingest_bench()
     extras = {**sched_extras, **e2e_transfers, **pipe_extras,
               **probe_extras, **adaptive_extras,
               **cache_extras(), **obs_metrics.resilience_extras(),
               **obs_metrics.ovl_extras(), **obs_metrics.dist_extras(),
-              **obs_metrics.redo_extras(), **dp_extras}
+              **obs_metrics.redo_extras(), **obs_metrics.ingest_extras(),
+              **ingest_bench_extras, **dp_extras}
     out = {
+        # metric_version 11: same primary value as versions 2-10 (the
+        # consensus bench itself reads no files). New in 11: the ingest
+        # data-plane extras (ISSUE 12) — ingest_mb_per_sec /
+        # ingest_serial_mb_per_sec / ingest_speedup_vs_serial from the
+        # multi-member-gzip micro-bench (_ingest_bench; parallel member
+        # inflate on the io/inflate.py worker pool vs the serial
+        # gzip.open reader, records asserted identical; speedup scales
+        # with physical cores), plus the registry's ingest_* accounting
+        # (bytes in/out, inflate/parse/wait seconds, blocks, records,
+        # ingest_fraction_of_wall) via ingest_extras. A perf number
+        # produced with the ingest gate off shows ingest_enabled=0.
         # metric_version 10: same primary value as versions 2-9 (the
         # bench's own compute path is untouched this round). New in 10:
         # the measured dp-scaling curve rides along when
@@ -349,7 +421,7 @@ def main():
         # fixed_engine_windows_per_sec. Bump this whenever the primary
         # value's definition changes, so round-over-round comparisons
         # can't silently mix metrics.
-        "metric_version": 10,
+        "metric_version": 11,
         "metric": f"POA windows/sec/chip, compute-only (direct-timed warm "
                   f"production chunk, convergence-scheduled refinement "
                   f"rounds — racon_tpu/sched/, telemetry in sched_* "

@@ -71,4 +71,7 @@ python scripts/failslow_smoke.py
 echo "[ci] chaos bench smoke (autoscaled fleet, evictions + straggler, makespan bound + byte-diff)"
 python scripts/chaos_bench.py --smoke
 
+echo "[ci] ingest smoke (parallel inflate plans, gz+plain 4-way byte-diff, ingest spans validate)"
+python scripts/ingest_smoke.py
+
 echo "[ci] OK"

@@ -160,6 +160,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 1
     # Below every early return: --version/--help/usage errors should not
     # pay the jax import the cache setup triggers.
+    import time as _time
+    _wall_t0 = _time.perf_counter()
     from racon_tpu.obs.trace import configure as configure_trace
     tracer = configure_trace(args.trace)
     from racon_tpu.utils.jaxcache import enable_compile_cache
@@ -459,13 +461,17 @@ def main(argv: Optional[List[str]] = None) -> int:
             store.close()
     out.flush()
     logger.total("[racon_tpu::Polisher::] total =")
-    from racon_tpu.obs.metrics import pipeline_extras
+    from racon_tpu.obs.metrics import pipeline_extras, set_ingest_fraction
     from racon_tpu.utils.jaxcache import cache_extras
+    from racon_tpu.io.ingest import ingest_enabled
     reg = obs_registry()
     for k, v in cache_extras(reg).items():
         reg.set(k, v)
     for k, v in pipeline_extras(reg).items():
         reg.set(k, v)
+    if int(reg.get("ingest_records", 0)):
+        reg.set("ingest_enabled", int(ingest_enabled()))
+        set_ingest_fraction(_time.perf_counter() - _wall_t0, reg)
     fleet.flush_final()
     tracer.finish(metrics=reg.snapshot())
     return rc

@@ -270,6 +270,14 @@ def run_worker(*, ledger_dir: str, fingerprint: str,
     instead of an eager ``n_targets`` so only the meta-publishing
     worker pays the target-file pass — every later joiner adopts the
     published count (WorkLedger.open docstring).
+
+    Ingest: workers ride the same RACON_TPU_INGEST data plane as the
+    serial CLI — ``scan_targets`` routes to the mmap structural scan
+    and every per-shard Polisher's initialize() uses the parallel
+    inflate / index-first readers, so fleets (and chaos drills armed at
+    ``io/read`` / ``io/inflate``) exercise exactly the production
+    reader. The gauge below puts the gate state in every fleet metric
+    shard.
     """
     out = out if out is not None else sys.stdout.buffer
     log = log if log is not None else sys.stderr
@@ -277,6 +285,9 @@ def run_worker(*, ledger_dir: str, fingerprint: str,
     ledger = WorkLedger.open(ledger_dir, fingerprint,
                              n_targets=n_targets, workers=workers,
                              lease_s=lease_s, scan_targets=scan_targets)
+    from racon_tpu.io.ingest import ingest_enabled
+    from racon_tpu.obs.metrics import registry as _registry
+    _registry().set("ingest_enabled", int(ingest_enabled()))
     set_dist("workers", int(workers))
     set_dist("shards", ledger.n_shards)
     set_dist("n_targets", ledger.n_targets)

@@ -45,6 +45,23 @@ def _open(path: str) -> io.BufferedReader:
     return open(path, "rb")
 
 
+def _open_source(path: str):
+    """The ingest-aware replacement for :func:`_open` on parser hot
+    paths: with the ``RACON_TPU_INGEST`` gate on (default),
+    ``.gz`` inputs open as a :class:`racon_tpu.io.inflate.ByteSource`
+    — a context-managed *iterable of decompressed blocks* whose
+    inflate runs on a worker pool (BGZF / multi-member) or a producer
+    thread (single-member stream), all byte-identical to
+    ``gzip.open``. :func:`_block_lines` accepts either shape. Gate
+    off, or plain files: the classic file object."""
+    if path.endswith(".gz"):
+        from racon_tpu.io.ingest import ingest_enabled
+        if ingest_enabled():
+            from racon_tpu.io.inflate import open_gzip_source
+            return open_gzip_source(path)
+    return _open(path)
+
+
 def _first_token(line: bytes) -> bytes:
     """Name = characters up to the first whitespace (bioparser semantics)."""
     for i, ch in enumerate(line):
@@ -128,6 +145,13 @@ class Parser:
                 consumed += nbytes
                 if 0 <= max_bytes <= consumed:
                     return out, True
+        except ParseError:
+            # The parallel inflate plane (io/inflate.py) raises typed,
+            # offset-bearing errors of its own (member ordinal +
+            # compressed offset); they pass through unchanged but still
+            # poison the parser.
+            self._failed = True
+            raise
         except (gzip.BadGzipFile, EOFError, OSError) as exc:
             # A mislabelled .gz (or truncated stream) must surface as this
             # parser's own error contract, not a raw gzip exception. Mark
@@ -167,15 +191,15 @@ def _block_lines(f, block: int = 1 << 22
     every line — a genome-scale cost (tens of millions of lines at 30x
     human coverage); one 4 MB read + one split amortizes it away.
     """
+    if hasattr(f, "read"):
+        blocks_iter = iter(lambda: f.read(block), b"")
+    else:
+        # An ingest ByteSource (io/inflate.py): already an iterable of
+        # decompressed blocks — empty blocks are skipped, not EOF.
+        blocks_iter = (b for b in f if b)
     tail: List[bytes] = []          # blocks of the current partial line
     pos = 0                         # stream offset of the current line
-    while True:
-        data = f.read(block)
-        if not data:
-            if tail:
-                last = b"".join(tail)
-                yield last.rstrip(b"\r"), len(last), pos
-            return
+    for data in blocks_iter:
         if b"\n" not in data:
             # No terminator in this block: defer the join, or a single
             # line longer than the block size (one-contig-per-line
@@ -189,6 +213,9 @@ def _block_lines(f, block: int = 1 << 22
             nb = len(ln) + 1
             yield ln.rstrip(b"\r"), nb, pos
             pos += nb
+    if tail:
+        last = b"".join(tail)
+        yield last.rstrip(b"\r"), len(last), pos
 
 
 def scan_sequence_index(path: str) -> Tuple[int, List[int]]:
@@ -205,6 +232,9 @@ def scan_sequence_index(path: str) -> Tuple[int, List[int]]:
     the count cheap for the one publishing worker, and every other
     worker skips the pass entirely.
     """
+    from racon_tpu.io.ingest import indexed_ok, scan_index_mmap
+    if indexed_ok(path) and path.endswith(_SEQ_EXTS):
+        return scan_index_mmap(path)
     offsets: List[int] = []
     hw = [0]                 # high-water offset for stream-level errors
 
@@ -224,12 +254,12 @@ def scan_sequence_index(path: str) -> Tuple[int, List[int]]:
 def _scan_index(path: str, offsets: List[int],
                 lines_of) -> Tuple[int, List[int]]:
     if path.endswith(_FASTA_EXTS):
-        with _open(path) as f:
+        with _open_source(path) as f:
             for line, _, off in lines_of(f):
                 if line.startswith(b">"):
                     offsets.append(off)
     elif path.endswith(_FASTQ_EXTS):
-        with _open(path) as f:
+        with _open_source(path) as f:
             lines = lines_of(f)
             while True:
                 header, _, rec_off = next(lines, (None, 0, 0))
@@ -262,6 +292,11 @@ def _scan_index(path: str, offsets: List[int],
                             f"file {path} — EOF inside the record "
                             f"starting", offset=rec_off)
                     qlen += len(line)
+                if qlen != dlen:
+                    raise ParseError(
+                        f"[racon_tpu::io] error: quality length mismatch "
+                        f"in {path} (sequence {dlen}, quality {qlen})",
+                        offset=rec_off)
     else:
         raise ParseError(
             f"[racon_tpu::create_polisher] error: file {path} has "
@@ -274,7 +309,7 @@ class FastaParser(Parser):
     def _records(self) -> Iterator[Tuple[Sequence, int]]:
         name: Optional[bytes] = None
         chunks: List[bytes] = []
-        with _open(self.path) as f:
+        with _open_source(self.path) as f:
             for line, _, off in self._lines(f):
                 if line.startswith(b">"):
                     if name is not None:
@@ -295,7 +330,7 @@ class FastaParser(Parser):
 
 class FastqParser(Parser):
     def _records(self) -> Iterator[Tuple[Sequence, int]]:
-        with _open(self.path) as f:
+        with _open_source(self.path) as f:
             lines = self._lines(f)
             while True:
                 header, _, rec_off = next(lines, (None, 0, 0))
@@ -336,9 +371,14 @@ class FastqParser(Parser):
                     qlen += len(line)
                 quality = b"".join(qual_chunks)
                 if len(quality) != len(data):
+                    # Silently mis-sized quality would flow into window
+                    # weighting downstream; name the record and where it
+                    # begins so the input is fixable.
                     raise ParseError(
                         f"[racon_tpu::io] error: quality length mismatch "
-                        f"in {self.path}", offset=rec_off)
+                        f"in {self.path} for record '{name.decode()}' "
+                        f"(sequence {len(data)}, quality {len(quality)})",
+                        offset=rec_off)
                 # Phred bytes below '!' (33) would decode to negative
                 # weights; reject here so every downstream consumer (host
                 # and device consensus paths) can assume weights >= 0 by
@@ -357,7 +397,7 @@ class MhapParser(Parser):
     b_end b_len) — reference ctor at src/overlap.cpp:15-27."""
 
     def _records(self) -> Iterator[Tuple[Overlap, int]]:
-        with _open(self.path) as f:
+        with _open_source(self.path) as f:
             for line, nb, off in self._lines(f):
                 if not line:
                     continue
@@ -379,7 +419,7 @@ class PafParser(Parser):
     src/overlap.cpp:29-42."""
 
     def _records(self) -> Iterator[Tuple[Overlap, int]]:
-        with _open(self.path) as f:
+        with _open_source(self.path) as f:
             for line, nb, off in self._lines(f):
                 if not line:
                     continue
@@ -400,7 +440,7 @@ class SamParser(Parser):
     reference ctor at src/overlap.cpp:44-108."""
 
     def _records(self) -> Iterator[Tuple[Overlap, int]]:
-        with _open(self.path) as f:
+        with _open_source(self.path) as f:
             for line, nb, off in self._lines(f):
                 if line.startswith(b"@"):
                     continue
@@ -418,11 +458,22 @@ class SamParser(Parser):
 
 
 def create_sequence_parser(path: str) -> Parser:
-    """Extension-dispatched sequence parser (src/polisher.cpp:78-92)."""
+    """Extension-dispatched sequence parser (src/polisher.cpp:78-92).
+
+    Plain (uncompressed) FASTA/FASTQ with the ``RACON_TPU_INGEST`` gate
+    on route to the mmap index-first readers (io/ingest.py) — byte-
+    identical records with zero-copy payload views; ``.gz`` inputs and
+    the gate-off escape hatch use the classic streaming readers (whose
+    ``.gz`` open itself routes through the parallel inflate plane when
+    the gate is on)."""
     if path.endswith(_FASTA_EXTS):
-        return FastaParser(path)
+        from racon_tpu.io.ingest import IndexedFastaParser, indexed_ok
+        return IndexedFastaParser(path) if indexed_ok(path) \
+            else FastaParser(path)
     if path.endswith(_FASTQ_EXTS):
-        return FastqParser(path)
+        from racon_tpu.io.ingest import IndexedFastqParser, indexed_ok
+        return IndexedFastqParser(path) if indexed_ok(path) \
+            else FastqParser(path)
     raise ParseError(
         f"[racon_tpu::create_polisher] error: file {path} has unsupported format "
         "extension (valid extensions: .fasta, .fasta.gz, .fa, .fa.gz, .fastq, "

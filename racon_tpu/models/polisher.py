@@ -112,9 +112,43 @@ class Polisher:
         log = self.logger
         log.begin()
 
+        # Ingest stage (ISSUE 12): with the RACON_TPU_INGEST gate on
+        # (and no io/* fault drill armed, which needs single-threaded
+        # determinism), all three input files parse on background
+        # threads concurrently — reads and overlaps inflate+parse while
+        # targets are consumed, so phases 1-3 wait only on the slowest
+        # file instead of the sum. Chunk protocol and error contract
+        # are identical to the serial loops; bounded queues cap the
+        # parsed-ahead memory at pipeline depth.
+        from racon_tpu.io.ingest import prefetch_ok
+        from racon_tpu.pipeline.streaming import (IngestPrefetcher,
+                                                  serial_chunks)
+        prefetchers: List[IngestPrefetcher] = []
+        if prefetch_ok():
+            pf_t = IngestPrefetcher(self.tparser, CHUNK_SIZE, "targets")
+            pf_s = IngestPrefetcher(self.sparser, CHUNK_SIZE, "reads")
+            pf_o = IngestPrefetcher(self.oparser, CHUNK_SIZE, "overlaps")
+            prefetchers = [pf_t, pf_s, pf_o]
+            src_t = pf_t.chunks()
+            src_s = pf_s.chunks()
+            src_o = pf_o.chunks()
+        else:
+            src_t = serial_chunks(self.tparser, CHUNK_SIZE)
+            src_s = serial_chunks(self.sparser, CHUNK_SIZE)
+            src_o = serial_chunks(self.oparser, CHUNK_SIZE)
+        try:
+            self._load_inputs(src_t, src_s, src_o, log)
+        finally:
+            for pf in prefetchers:
+                pf.close()
+
+    def _load_inputs(self, src_t, src_s, src_o, log) -> None:
+        """Phases 1-7 of initialize(), consuming the three ingest chunk
+        streams (prefetched or serial — same protocol)."""
         # 1. Targets (src/polisher.cpp:172-187).
-        self.tparser.reset()
-        self.sequences = list(self.tparser.parse_all())
+        self.sequences = []
+        for chunk, _more in src_t:
+            self.sequences.extend(chunk)
         targets_size = len(self.sequences)
         if targets_size == 0:
             raise PolisherError(
@@ -139,9 +173,7 @@ class Polisher:
         # (src/polisher.cpp:196-234).
         sequences_size = 0
         total_len = 0
-        self.sparser.reset()
-        while True:
-            chunk, more = self.sparser.parse(CHUNK_SIZE)
+        for chunk, _more in src_s:
             for seq in chunk:
                 total_len += len(seq.data)
                 tid = name_to_id.get(seq.name + "t")
@@ -160,8 +192,6 @@ class Polisher:
                     name_to_id[seq.name + "q"] = idx
                     id_to_id[sequences_size << 1 | 0] = idx
                 sequences_size += 1
-            if not more:
-                break
         if sequences_size == 0:
             raise PolisherError(
                 "[racon_tpu::Polisher::initialize] error: "
@@ -195,9 +225,7 @@ class Polisher:
             overlaps.extend(kept)
             group.clear()
 
-        self.oparser.reset()
-        while True:
-            chunk, more = self.oparser.parse(CHUNK_SIZE)
+        for chunk, _more in src_o:
             for o in chunk:
                 o.transmute(self.sequences, name_to_id, id_to_id)
                 if not o.is_valid:
@@ -205,8 +233,6 @@ class Polisher:
                 if group and group[-1].q_id != o.q_id:
                     flush_group()
                 group.append(o)
-            if not more:
-                break
         flush_group()
         del name_to_id, id_to_id
 

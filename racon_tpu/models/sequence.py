@@ -25,6 +25,27 @@ import numpy as np
 from racon_tpu.ops.encode import reverse_complement
 
 
+def _upper(data):
+    """``bytes.upper`` that preserves zero-copy ``memoryview`` payloads
+    (io/ingest.py mmap plane): a vectorized lowercase scan first — the
+    overwhelmingly common all-uppercase FASTA/FASTQ keeps its view; any
+    lowercase base falls back to one uppercased ``bytes`` copy."""
+    if isinstance(data, (bytes, bytearray)):
+        return data.upper()
+    arr = np.frombuffer(data, dtype=np.uint8)
+    if bool(np.any((arr >= 0x61) & (arr <= 0x7A))):
+        return bytes(data).upper()
+    return data
+
+
+def _all_bang(quality) -> bool:
+    """All-``!`` check without materializing a view payload."""
+    if isinstance(quality, (bytes, bytearray)):
+        return quality.count(b"!") == len(quality)
+    arr = np.frombuffer(quality, dtype=np.uint8)
+    return bool(np.all(arr == 0x21)) if arr.size else True
+
+
 class Sequence:
     __slots__ = (
         "name",
@@ -38,9 +59,9 @@ class Sequence:
 
     def __init__(self, name: str, data: bytes, quality: Optional[bytes] = None):
         self.name = name
-        self.data = data.upper()
+        self.data = _upper(data)
         # All-'!' quality (Phred sum == 0) counts as no quality.
-        if quality is not None and quality.count(b"!") == len(quality):
+        if quality is not None and _all_bang(quality):
             quality = None
         self.quality = quality
         self.reverse_complement: Optional[bytes] = None
@@ -56,7 +77,10 @@ class Sequence:
             return
         self.reverse_complement = reverse_complement(self.data)
         if self.quality is not None:
-            self.reverse_quality = self.quality[::-1]
+            qual = self.quality
+            if not isinstance(qual, (bytes, bytearray)):
+                qual = bytes(qual)  # mmap view: [::-1] is non-contiguous
+            self.reverse_quality = qual[::-1]
 
     def transmute(self, has_name: bool, has_data: bool, has_reverse_data: bool) -> None:
         """Free unneeded fields / build reverse complement.

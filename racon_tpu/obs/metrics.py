@@ -395,6 +395,87 @@ def redo_extras(reg: Optional[MetricsRegistry] = None
     return out
 
 
+# ------------------------------------------------------- ingest plane
+
+def record_ingest_inflate(mode: str, bytes_in: int, bytes_out: int,
+                          seconds: float, blocks: int,
+                          reg: Optional[MetricsRegistry] = None) -> None:
+    """Account one gzip source's inflate totals (io/inflate.py, called
+    once when the source finishes): the inflate plan (``bgzf`` /
+    ``members`` / ``stream``), compressed bytes consumed, decompressed
+    bytes produced, summed worker-pool inflate seconds (may exceed wall
+    on the parallel paths — that is the point), and blocks/members
+    inflated. Emits one ``ingest`` trace span per source."""
+    reg = reg if reg is not None else _REGISTRY
+    reg.inc("ingest_bytes_in", int(bytes_in))
+    reg.inc("ingest_bytes_out", int(bytes_out))
+    reg.inc("ingest_inflate_s", float(seconds))
+    reg.inc("ingest_blocks", int(blocks))
+    _trace.get_tracer().point("ingest", f"inflate/{mode}",
+                              dur_s=float(seconds), mode=mode,
+                              bytes=int(bytes_out), blocks=int(blocks))
+
+
+def record_ingest_parse(mode: str, seconds: float, records: int,
+                        raw_bytes: int,
+                        reg: Optional[MetricsRegistry] = None) -> None:
+    """Account one file's parse totals: the reader plan (``indexed`` /
+    ``serial`` / ``prefetch``), seconds spent turning bytes into
+    records (on the prefetch thread when overlapped, inline otherwise),
+    records produced, and raw (decompressed) bytes consumed. Emits one
+    ``ingest`` trace span per file."""
+    reg = reg if reg is not None else _REGISTRY
+    reg.inc("ingest_parse_s", float(seconds))
+    reg.inc("ingest_records", int(records))
+    reg.inc("ingest_raw_bytes", int(raw_bytes))
+    _trace.get_tracer().point("ingest", f"parse/{mode}",
+                              dur_s=float(seconds), mode=mode,
+                              bytes=int(raw_bytes), records=int(records))
+
+
+def record_ingest_wait(seconds: float,
+                       reg: Optional[MetricsRegistry] = None) -> None:
+    """Account consumer time blocked on ingest — the ONLY ingest term
+    on the run's critical path when prefetch overlaps. Serial (non-
+    prefetch) ingest books its whole parse wall here."""
+    reg = reg if reg is not None else _REGISTRY
+    reg.inc("ingest_wait_s", float(seconds))
+
+
+def set_ingest_fraction(wall_s: float,
+                        reg: Optional[MetricsRegistry] = None) -> None:
+    """Derive and set the ``ingest_fraction_of_wall`` gauge = critical-
+    path ingest wait / total run wall (cli.py, end of run). A fraction
+    near 0 with nonzero ingest_parse_s means the overlap worked."""
+    reg = reg if reg is not None else _REGISTRY
+    if wall_s > 0:
+        wait = float(reg.get("ingest_wait_s", 0.0))
+        reg.set("ingest_fraction_of_wall", round(wait / wall_s, 4))
+
+
+def ingest_extras(reg: Optional[MetricsRegistry] = None
+                  ) -> Dict[str, object]:
+    """The registry's ingest_* keys as a JSON-ready dict (bench extras
+    metric_version 11 / obs_report "ingest:" section), plus derived
+    ``ingest_mb_per_sec`` (decompressed MB over inflate+parse seconds).
+    Empty when no ingest accounting ran."""
+    reg = reg if reg is not None else _REGISTRY
+    out: Dict[str, object] = {}
+    for k, v in sorted(reg.snapshot().items()):
+        if k.startswith("ingest_"):
+            out[k] = round(v, 4) if isinstance(v, float) else v
+    if not out:
+        return out
+    raw = float(reg.get("ingest_raw_bytes", 0.0)) or float(
+        reg.get("ingest_bytes_out", 0.0))
+    busy = float(reg.get("ingest_inflate_s", 0.0)) + float(
+        reg.get("ingest_parse_s", 0.0))
+    if raw > 0 and busy > 0:
+        out["ingest_mb_per_sec"] = round(raw / busy / 1e6, 2)
+    out["ingest_seconds"] = round(busy, 4)
+    return out
+
+
 # ------------------------------------------------------ pipeline gauges
 
 def record_stage(name: str, busy_s: float, stall_in_s: float,
@@ -519,6 +600,9 @@ _MERGE_LAST_KEYS = frozenset({
     # folded into the fleet model from the supervisor heartbeat, never
     # summed across workers.
     "fleet_target_workers",
+    # Ingest plane gauges (io/ingest.py): per-run derived ratio and the
+    # gate state — the ingest_* byte/second/record counters sum.
+    "ingest_fraction_of_wall", "ingest_enabled",
 })
 
 

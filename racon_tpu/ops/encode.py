@@ -39,5 +39,9 @@ def decode_bases(codes: np.ndarray) -> bytes:
 
 
 def reverse_complement(data: bytes) -> bytes:
-    """Reverse complement of raw ASCII sequence data."""
+    """Reverse complement of raw ASCII sequence data. Accepts the
+    ingest plane's ``memoryview`` payloads (one copy here is
+    unavoidable — the result is a new reversed string anyway)."""
+    if not isinstance(data, (bytes, bytearray)):
+        data = bytes(data)
     return data.translate(COMPLEMENT_TABLE)[::-1]

@@ -43,7 +43,118 @@ import time
 from typing import Dict, Iterator, List, Optional, Tuple
 
 from racon_tpu.pipeline import pipeline_depth
+from racon_tpu.pipeline.queues import BoundedQueue, PipelineAborted, QueueClosed
 from racon_tpu.pipeline.stages import Pipeline, StageError
+
+
+class IngestPrefetcher:
+    """The ingest stage: background-parse a file's chunks ahead of
+    consumption so parsing of chunk N+1 hides under chunk N's device
+    rounds (and, at polisher startup, the three input files parse
+    concurrently instead of serially).
+
+    One producer thread runs ``parser.reset()`` then chunked
+    ``parser.parse(max_bytes)`` into a bounded queue (depth =
+    pipeline depth — same backpressure discipline as the polish
+    pipeline, so a slow consumer caps parsed-ahead memory).
+    The consumer iterates :meth:`chunks`; only its *blocked* time books
+    as ``ingest_wait_s`` (the critical-path term), while the producer's
+    parse wall books as ``ingest_parse_s`` — when overlap works,
+    wait ≪ parse. A producer-side :class:`ParseError` re-raises in the
+    consumer, preserving the serial error contract.
+
+    Always ``close()`` in a finally: an abandoned consumer aborts the
+    queue, which unblocks and retires the producer thread.
+    """
+
+    def __init__(self, parser, max_bytes: int, label: str = "ingest",
+                 depth: Optional[int] = None):
+        self._parser = parser
+        self._max_bytes = max_bytes
+        self._q = BoundedQueue(f"ingest_{label}",
+                               depth if depth is not None
+                               else max(pipeline_depth(), 2))
+        self._err: List[BaseException] = []
+        self._parse_s = 0.0
+        self._records = 0
+        self._thread = threading.Thread(
+            target=self._produce, name=f"racon-ingest-{label}",
+            daemon=True)
+        self._thread.start()
+
+    def _produce(self) -> None:
+        try:
+            self._parser.reset()
+            while True:
+                t0 = time.perf_counter()
+                chunk, more = self._parser.parse(self._max_bytes)
+                self._parse_s += time.perf_counter() - t0
+                self._records += len(chunk)
+                self._q.put((chunk, more))
+                if not more:
+                    break
+            self._q.close()
+        except PipelineAborted:
+            pass                    # consumer went away first
+        except BaseException as exc:
+            self._err.append(exc)
+            self._q.abort()
+
+    def chunks(self) -> Iterator[Tuple[List, bool]]:
+        """Yield ``(records, more)`` chunks in parse order; blocked time
+        accounts as ingest wait."""
+        from racon_tpu.obs.metrics import record_ingest_wait
+        while True:
+            t0 = time.perf_counter()
+            try:
+                chunk, more = self._q.get()
+            except QueueClosed:
+                return
+            except PipelineAborted:
+                if self._err:
+                    raise self._err[0]
+                raise
+            finally:
+                record_ingest_wait(time.perf_counter() - t0)
+            yield chunk, more
+            if not more:
+                return
+
+    def close(self) -> None:
+        """Tear down (idempotent): abort the queue, join the producer,
+        flush this file's parse accounting."""
+        from racon_tpu.obs.metrics import record_ingest_parse
+        self._q.abort()
+        self._thread.join(timeout=30.0)
+        if self._records or self._parse_s:
+            record_ingest_parse("prefetch", self._parse_s, self._records,
+                                self._parser._pos)
+            self._records = 0
+            self._parse_s = 0.0
+
+
+def serial_chunks(parser, max_bytes: int) -> Iterator[Tuple[List, bool]]:
+    """The non-overlapped ingest path (prefetch unavailable: gate off,
+    or an io/* fault drill needs single-threaded determinism): same
+    ``(records, more)`` chunk protocol, parse wall booked as BOTH parse
+    and wait seconds — serial ingest is all critical path."""
+    from racon_tpu.obs.metrics import record_ingest_parse, record_ingest_wait
+    parser.reset()
+    parse_s = 0.0
+    records = 0
+    try:
+        while True:
+            t0 = time.perf_counter()
+            chunk, more = parser.parse(max_bytes)
+            parse_s += time.perf_counter() - t0
+            records += len(chunk)
+            yield chunk, more
+            if not more:
+                return
+    finally:
+        if records or parse_s:
+            record_ingest_parse("serial", parse_s, records, parser._pos)
+            record_ingest_wait(parse_s)
 
 
 class _Item:

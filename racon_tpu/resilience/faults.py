@@ -41,7 +41,15 @@ worker's eviction points ``dist/claim`` / ``dist/shard`` /
 ``dist/contig`` / ``dist/merge`` and the split-publication window
 ``dist/split`` (a ``torn`` there leaves a half-written child .range
 that every reader must treat as "no split happened";
-racon_tpu/distributed/). Call indices
+racon_tpu/distributed/), and the ingest plane's read sites
+``io/read`` (one consult per parsed line on the streaming readers,
+per *record* on the mmap index-first readers — there are no lines
+there) and ``io/inflate`` (once per gzip block/member handed to the
+parallel inflate pool; a ``raise``/``torn`` there models a torn or
+short compressed read and must surface as the offset-bearing
+ParseError contract). Arming any ``io/*`` site disables ingest
+*prefetch concurrency* (io/ingest.prefetch_ok) so explicit call
+indices stay deterministic. Call indices
 are 0-based and advance once per *attempt* at that site (each retry
 re-consults the injector), so ``site:0,1`` verifies genuine two-failure
 recovery.

@@ -1,0 +1,130 @@
+"""CI smoke: the ingest data plane, differential + trace-gated.
+
+Three gates in one script (ISSUE 12):
+
+1. **Raw inflate byte-diff** — a multi-member gzip and a BGZF file
+   round-trip through io/inflate.py's parallel plans byte-identical to
+   ``gzip.decompress``, and mid-member truncation raises the
+   offset-bearing ParseError (member ordinal + compressed offset).
+2. **CLI differential** — the full polish runs on gzipped AND plain
+   inputs with ``RACON_TPU_INGEST=0`` (serial readers) and ``=1``
+   (parallel inflate + mmap index-first readers + prefetch overlap);
+   all four polished FASTAs must be byte-identical.
+3. **Obs contract** — the gated gzipped run's trace validates against
+   the documented schema and contains ``ingest`` spans; the metrics
+   footer carries the ingest_* accounting.
+"""
+
+import contextlib
+import gzip
+import io
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from racon_tpu import cli                            # noqa: E402
+from scripts import obs_report                       # noqa: E402
+from scripts.obs_smoke import _write_inputs          # noqa: E402
+
+
+def _run_cli(d, reads, ovl, draft, trace=None):
+    if trace is not None:
+        os.environ["RACON_TPU_TRACE"] = trace
+    else:
+        os.environ.pop("RACON_TPU_TRACE", None)
+
+    class _Capture(io.StringIO):
+        pass
+
+    stdout = _Capture()
+    stdout.buffer = io.BytesIO()
+    with contextlib.redirect_stdout(stdout):
+        rc = cli.main(["--backend", "jax", reads, ovl, draft])
+    assert rc == 0, f"cli exited {rc}"
+    return stdout.buffer.getvalue()
+
+
+def _check_inflate(d):
+    """Gate 1: parallel inflate plans vs gzip.decompress + truncation."""
+    from racon_tpu.io.inflate import open_gzip_source
+    from racon_tpu.io.parsers import ParseError
+
+    payload = b"".join(b">m%d\n%s\n" % (i, b"ACGT" * 600)
+                       for i in range(64))
+    multi = os.path.join(d, "multi.fasta.gz")
+    with open(multi, "wb") as fh:
+        for i in range(0, len(payload), len(payload) // 8):
+            fh.write(gzip.compress(payload[i:i + len(payload) // 8]))
+    with open_gzip_source(multi) as src:
+        got = b"".join(src.blocks())
+    assert src.mode == "members", f"expected members plan, got {src.mode}"
+    assert got == payload, "parallel member inflate diverged"
+
+    blob = open(multi, "rb").read()
+    trunc = os.path.join(d, "trunc.fasta.gz")
+    open(trunc, "wb").write(blob[:-32])
+    try:
+        with open_gzip_source(trunc) as src:
+            b"".join(src.blocks())
+        raise AssertionError("truncated gzip did not raise")
+    except ParseError as exc:
+        msg = str(exc)
+        assert "member" in msg and "compressed offset" in msg, msg
+    print("[ingest-smoke] inflate plans ok (members byte-identical, "
+          "truncation offset-bearing)", flush=True)
+
+
+def main():
+    with tempfile.TemporaryDirectory() as d:
+        _check_inflate(d)
+
+        _write_inputs(d)
+        plain = [os.path.join(d, n)
+                 for n in ("reads.fasta", "ovl.paf", "draft.fasta")]
+        gz = [p + ".gz" for p in plain]
+        for src, dst in zip(plain, gz):
+            with open(src, "rb") as fi, open(dst, "wb") as fo:
+                # Two members so the gated run takes the parallel plan.
+                data = fi.read()
+                fo.write(gzip.compress(data[:len(data) // 2]))
+                fo.write(gzip.compress(data[len(data) // 2:]))
+
+        outs = {}
+        from racon_tpu.obs import metrics as obs_metrics
+        trace = os.path.join(d, "trace.jsonl")
+        for gate in ("0", "1"):
+            os.environ["RACON_TPU_INGEST"] = gate
+            outs[("plain", gate)] = _run_cli(d, *plain)
+            obs_metrics.reset()
+            outs[("gz", gate)] = _run_cli(
+                d, *gz, trace=trace if gate == "1" else None)
+        os.environ.pop("RACON_TPU_INGEST", None)
+        os.environ.pop("RACON_TPU_TRACE", None)
+
+        vals = set(outs.values())
+        assert len(vals) == 1 and outs[("plain", "0")].startswith(b">"), \
+            f"ingest outputs diverged across {sorted(outs)}"
+        print("[ingest-smoke] 4-way byte-identity ok "
+              "(plain/gz x gate off/on)", flush=True)
+
+        tr = obs_report.load_trace(trace)
+        errs = obs_report.validate(tr)
+        assert not errs, "trace schema violations:\n" + "\n".join(errs)
+        kinds = {s["kind"] for s in tr["spans"].values()}
+        assert "ingest" in kinds, f"no ingest span in trace ({kinds})"
+        modes = {s.get("mode") for s in tr["spans"].values()
+                 if s["kind"] == "ingest"}
+        m = tr["metrics"]
+        assert m is not None and m.get("ingest_records", 0) > 0, \
+            "no ingest accounting in metrics footer"
+        assert m.get("ingest_bytes_out", 0) > 0, "no inflate accounting"
+        print(f"[ingest-smoke] trace ok: ingest modes={sorted(modes)}, "
+              f"records={m['ingest_records']}, "
+              f"inflate_bytes={m['ingest_bytes_out']}", flush=True)
+    print("[ingest-smoke] PASS", flush=True)
+
+
+if __name__ == "__main__":
+    main()

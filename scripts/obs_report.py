@@ -55,6 +55,10 @@ KIND_REQUIRED_ATTRS = {
     # One pipeline stall-detector firing (pipeline/stages.py): the
     # silence window that tripped it and how many stages were frozen.
     "stall": ("window_s", "stages"),
+    # One ingest-plane event (io/inflate.py inflate/<plan>,
+    # obs/metrics.py parse/<reader>): which plan ran and how many
+    # decompressed/raw bytes it moved.
+    "ingest": ("mode", "bytes"),
 }
 
 # Span intervals are rounded to 1e-6 on write and a parent's clock stops
@@ -230,6 +234,7 @@ def render(tr: Dict[str, object], out=None,
               f"of run wall", file=out)
 
     m = tr["metrics"]
+    _render_ingest(m, by_kind, out)
     _render_pipeline(m, out)
     _render_resilience(m, by_kind, out)
     _render_dist(m, by_kind, out)
@@ -241,6 +246,33 @@ def render(tr: Dict[str, object], out=None,
         print("\nmetrics:", file=out)
         for k in keys:
             print(f"  {k} = {m[k]}", file=out)
+
+
+def _render_ingest(m, by_kind, out) -> None:
+    """The "ingest:" section: data-plane totals (bytes through the
+    inflate pool, parse/wait split, fraction of wall) plus one line per
+    ``ingest`` span (which inflate plan / reader each file used). Runs
+    that never booked ingest accounting print nothing."""
+    m = m or {}
+    if not (int(m.get("ingest_records", 0) or 0)
+            or int(m.get("ingest_blocks", 0) or 0)):
+        return
+    bin_ = int(m.get("ingest_bytes_in", 0) or 0)
+    bout = int(m.get("ingest_bytes_out", 0) or 0)
+    raw = int(m.get("ingest_raw_bytes", 0) or 0)
+    print(f"\ningest: records={int(m.get('ingest_records', 0) or 0)}  "
+          f"raw={raw / 1e6:.1f}MB  "
+          f"inflate={bin_ / 1e6:.1f}→{bout / 1e6:.1f}MB "
+          f"({int(m.get('ingest_blocks', 0) or 0)} block(s))", file=out)
+    print(f"  inflate={float(m.get('ingest_inflate_s', 0) or 0):.3f}s  "
+          f"parse={float(m.get('ingest_parse_s', 0) or 0):.3f}s  "
+          f"wait={float(m.get('ingest_wait_s', 0) or 0):.3f}s"
+          + (f"  fraction_of_wall="
+             f"{float(m['ingest_fraction_of_wall']):.4f}"
+             if "ingest_fraction_of_wall" in m else ""), file=out)
+    for s in by_kind.get("ingest", []):
+        print(f"  {s['name']:<16} {s.get('bytes', 0) / 1e6:>8.1f}MB  "
+              f"{s['dur_s']:.3f}s", file=out)
 
 
 _STAGE_SUFFIXES = ("_busy_s", "_stall_in_s", "_stall_out_s", "_items")

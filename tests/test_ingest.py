@@ -1,0 +1,468 @@
+"""Ingest data-plane tests (ISSUE 12): parallel inflate plan
+equivalence, mmap index-first reader parity, the zero-copy invariant,
+offset-bearing truncation errors, fault-site recovery, prefetch
+overlap, and the RACON_TPU_INGEST gate differential.
+
+The contract under test everywhere: whatever path the gate selects —
+BGZF worker-pool inflate, multi-member inflate, streamed single-member
+inflate, or the mmap index-first readers — records, offsets, errors,
+and polished output are byte-identical to the serial PR-8 readers.
+"""
+
+import contextlib
+import gzip
+import io
+import os
+import struct
+import zlib
+
+import numpy as np
+import pytest
+
+from racon_tpu.io import ingest as ingest_mod
+from racon_tpu.io.inflate import bgzf_block_size, open_gzip_source
+from racon_tpu.io.ingest import (IndexedFastaParser, IndexedFastqParser,
+                                 materialized_copies, prefetch_ok,
+                                 reset_materialized, scan_index_mmap)
+from racon_tpu.io.parsers import (CHUNK_SIZE, FastaParser, FastqParser,
+                                  ParseError, create_sequence_parser,
+                                  scan_sequence_index)
+from racon_tpu.pipeline.streaming import IngestPrefetcher, serial_chunks
+from racon_tpu.resilience import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    monkeypatch.delenv("RACON_TPU_INGEST", raising=False)
+    monkeypatch.delenv("RACON_TPU_INGEST_WORKERS", raising=False)
+    faults.configure(None)
+    reset_materialized()
+    yield
+    faults.configure(None)
+
+
+def _bgzf_block(payload: bytes) -> bytes:
+    co = zlib.compressobj(6, zlib.DEFLATED, -15)
+    cdata = co.compress(payload) + co.flush()
+    bsize = len(cdata) + 26            # 12 hdr + 6 extra + 8 footer
+    return (b"\x1f\x8b\x08\x04" + b"\x00" * 6 + struct.pack("<H", 6)
+            + b"BC" + struct.pack("<HH", 2, bsize - 1) + cdata
+            + struct.pack("<II", zlib.crc32(payload) & 0xFFFFFFFF,
+                          len(payload)))
+
+
+def _write_bgzf(path, payload, block=4096):
+    with open(path, "wb") as fh:
+        for i in range(0, len(payload), block):
+            fh.write(_bgzf_block(payload[i:i + block]))
+        fh.write(_bgzf_block(b""))     # BGZF EOF marker
+
+
+def _write_members(path, payload, n=6):
+    step = max(len(payload) // n, 1)
+    with open(path, "wb") as fh:
+        for i in range(0, len(payload), step):
+            fh.write(gzip.compress(payload[i:i + step]))
+
+
+FA_PAYLOAD = b"".join(
+    b">r%d desc %d\nACGTTGCA%d\nGGGGCC\n" % (i, i, i) for i in range(400))
+FQ_PAYLOAD = b"".join(
+    b"@q%d\nACGTACGTAC\n+\nIIIIJJJJKK\n" % i for i in range(400))
+
+
+# --------------------------------------------------------- inflate plans
+
+def test_bgzf_header_detection(tmp_path):
+    p = str(tmp_path / "x.gz")
+    _write_bgzf(p, b"hello world")
+    blob = open(p, "rb").read()
+    size = bgzf_block_size(blob, 0, len(blob))
+    assert size is not None and 0 < size <= len(blob)
+    # A plain gzip member has no BC subfield.
+    assert bgzf_block_size(gzip.compress(b"x"), 0, 99) is None
+
+
+def test_plan_selection_and_roundtrip(tmp_path):
+    cases = {}
+    p = str(tmp_path / "bg.fasta.gz")
+    _write_bgzf(p, FA_PAYLOAD)
+    cases[p] = "bgzf"
+    p = str(tmp_path / "mm.fasta.gz")
+    _write_members(p, FA_PAYLOAD)
+    cases[p] = "members"
+    p = str(tmp_path / "st.fasta.gz")
+    open(p, "wb").write(gzip.compress(FA_PAYLOAD))
+    cases[p] = "stream"
+    p = str(tmp_path / "empty.fasta.gz")
+    open(p, "wb").close()
+    cases[p] = "empty"
+    for path, want in cases.items():
+        with open_gzip_source(path) as src:
+            got = b"".join(src.blocks())
+        assert src.mode == want, (path, src.mode)
+        assert got == (FA_PAYLOAD if want != "empty" else b"")
+
+
+def test_parser_equivalence_across_plans(tmp_path):
+    """BGZF vs multi-member vs streamed gzip vs mmap plain file: same
+    records (names, data, quality) from create_sequence_parser."""
+    paths = {}
+    for tag, payload, ext in (("fa", FA_PAYLOAD, "fasta"),
+                              ("fq", FQ_PAYLOAD, "fastq")):
+        plain = str(tmp_path / f"{tag}.{ext}")
+        open(plain, "wb").write(payload)
+        bg = str(tmp_path / f"{tag}_bg.{ext}.gz")
+        _write_bgzf(bg, payload)
+        mm = str(tmp_path / f"{tag}_mm.{ext}.gz")
+        _write_members(mm, payload)
+        st = str(tmp_path / f"{tag}_st.{ext}.gz")
+        open(st, "wb").write(gzip.compress(payload))
+        paths[tag] = (plain, bg, mm, st)
+
+    for tag, group in paths.items():
+        outs = []
+        for path in group:
+            for gate in ("0", "1"):
+                os.environ["RACON_TPU_INGEST"] = gate
+                recs = [(s.name, bytes(s.data),
+                         None if s.quality is None else bytes(s.quality))
+                        for s in create_sequence_parser(path).parse_all()]
+                outs.append(recs)
+        assert all(o == outs[0] for o in outs), tag
+        assert len(outs[0]) == 400
+
+
+def test_chunked_parse_boundary_parity(tmp_path):
+    """parse(max_bytes) must cut chunks at the same records on the
+    indexed reader as on the serial one (identical nbytes budget)."""
+    plain = str(tmp_path / "x.fasta")
+    open(plain, "wb").write(FA_PAYLOAD)
+    for mb in (1, 64, 333):
+        serial, indexed = FastaParser(plain), IndexedFastaParser(plain)
+        while True:
+            c1, m1 = serial.parse(mb)
+            c2, m2 = indexed.parse(mb)
+            assert [s.name for s in c1] == [s.name for s in c2]
+            assert m1 == m2
+            if not m1:
+                break
+
+
+def test_scan_offsets_equivalence(tmp_path):
+    for payload, ext in ((FA_PAYLOAD, "fasta"), (FQ_PAYLOAD, "fastq")):
+        plain = str(tmp_path / f"s.{ext}")
+        open(plain, "wb").write(payload)
+        os.environ["RACON_TPU_INGEST"] = "0"
+        serial = scan_sequence_index(plain)
+        os.environ["RACON_TPU_INGEST"] = "1"
+        assert scan_index_mmap(plain) == serial
+        assert scan_sequence_index(plain) == serial   # dispatches mmap
+        assert serial[0] == 400
+
+
+# ----------------------------------------------------------- zero-copy
+
+def test_zero_copy_invariant_single_line(tmp_path):
+    """Single-line-per-record files must produce memoryview payloads
+    with ZERO bytes materializations (the counting shim is the gate)."""
+    fa = str(tmp_path / "z.fasta")
+    open(fa, "wb").write(b">a\nACGTACGTAC\n>b\nTTTTGGGG\n")
+    fq = str(tmp_path / "z.fastq")
+    open(fq, "wb").write(b"@a\nACGT\n+\nIIII\n@b\nGGCC\n+\nJJJJ\n")
+    reset_materialized()
+    fa_recs = IndexedFastaParser(fa).parse_all()
+    fq_recs = IndexedFastqParser(fq).parse_all()
+    assert materialized_copies() == 0
+    for s in fa_recs + fq_recs:
+        assert isinstance(s.data, memoryview), type(s.data)
+    assert all(isinstance(s.quality, memoryview) for s in fq_recs)
+    # And the views feed the packed device encode with no copy.
+    from racon_tpu.ops.encode import encode_bases
+    enc = encode_bases(fa_recs[0].data)
+    assert enc.tolist() == encode_bases(b"ACGTACGTAC").tolist()
+
+
+def test_zero_copy_counts_multiline_joins(tmp_path):
+    fa = str(tmp_path / "w.fasta")
+    open(fa, "wb").write(b">a\nACGT\nACGT\n>b\nGGGG\n")
+    reset_materialized()
+    recs = IndexedFastaParser(fa).parse_all()
+    assert bytes(recs[0].data) == b"ACGTACGT"
+    assert materialized_copies() == 1      # the wrapped record only
+
+
+# ------------------------------------------------- offset-bearing errors
+
+def test_multimember_truncation_ordinal_and_offset(tmp_path):
+    p = str(tmp_path / "t.fasta.gz")
+    _write_members(p, FA_PAYLOAD, n=6)
+    blob = open(p, "rb").read()
+    open(p, "wb").write(blob[:-25])        # tear the final member
+    with pytest.raises(ParseError) as ei:
+        FastaParser(p).parse_all()
+    msg = str(ei.value)
+    assert "member" in msg and "compressed offset" in msg, msg
+    assert ei.value.offset is not None and 0 < ei.value.offset < len(blob)
+
+
+def test_large_gzip_truncation_offset(tmp_path):
+    """>=4 MB multi-member gzip torn mid-member: the error names the
+    member ordinal and a compressed offset inside the file."""
+    line = bytes(np.frombuffer(b"ACGT", np.uint8)[
+        np.random.default_rng(5).integers(0, 4, 1 << 20)])
+    payload = b"".join(b">c%d\n%s\n" % (i, line) for i in range(8))
+    assert len(payload) > 4 << 20
+    p = str(tmp_path / "big.fasta.gz")
+    _write_members(p, payload, n=8)
+    blob = open(p, "rb").read()
+    assert len(blob) > 1 << 20
+    open(p, "wb").write(blob[:len(blob) // 2])   # cut deep mid-file
+    with pytest.raises(ParseError) as ei:
+        create_sequence_parser(p).parse_all()
+    msg = str(ei.value)
+    assert "compressed offset" in msg and "member" in msg, msg
+    assert 0 < ei.value.offset <= len(blob) // 2
+
+
+def test_fastq_quality_mismatch_names_record_and_offset(tmp_path):
+    bad = b"@ok\nACGT\n+\nIIII\n@broke\nACGT\n+\nIIIIII\n"
+    p = str(tmp_path / "bad.fastq")
+    open(p, "wb").write(bad)
+    msgs = []
+    for cls in (FastqParser, IndexedFastqParser):
+        with pytest.raises(ParseError) as ei:
+            cls(p).parse_all()
+        assert "'broke'" in str(ei.value)
+        assert ei.value.offset == bad.index(b"@broke")
+        msgs.append(str(ei.value))
+    assert msgs[0] == msgs[1]              # identical error contract
+    # The structural scan rejects it too, on both paths.
+    for gate in ("0", "1"):
+        os.environ["RACON_TPU_INGEST"] = gate
+        with pytest.raises(ParseError, match="quality length mismatch"):
+            scan_sequence_index(p)
+
+
+# -------------------------------------------------- fault-site recovery
+
+def test_io_inflate_fault_surfaces_and_recovers(tmp_path):
+    p = str(tmp_path / "f.fasta.gz")
+    _write_members(p, FA_PAYLOAD, n=4)
+    faults.configure("io/inflate:0")
+    with pytest.raises(ParseError, match="read failure"):
+        FastaParser(p).parse_all()
+    faults.configure(None)
+    recs = FastaParser(p).parse_all()      # clean retry: full parse
+    assert len(recs) == 400
+
+
+def test_io_inflate_torn_degrades_to_short_read(tmp_path):
+    """torn at the read-only io/inflate site = the short-read drill
+    (resilience/faults.py degrades torn to raise at non-write sites)."""
+    p = str(tmp_path / "g.fasta.gz")
+    _write_bgzf(p, FA_PAYLOAD)
+    faults.configure("io/inflate:1!torn")
+    with pytest.raises(ParseError):
+        FastaParser(p).parse_all()
+    faults.configure(None)
+    assert len(FastaParser(p).parse_all()) == 400
+
+
+def test_io_read_fault_on_indexed_reader(tmp_path):
+    plain = str(tmp_path / "h.fasta")
+    open(plain, "wb").write(FA_PAYLOAD)
+    faults.configure("io/read:2")
+    with pytest.raises(ParseError, match="read failure"):
+        IndexedFastaParser(plain).parse_all()
+    faults.configure(None)
+    assert len(IndexedFastaParser(plain).parse_all()) == 400
+
+
+def test_prefetch_disabled_under_io_faults():
+    assert prefetch_ok()
+    faults.configure("io/read:5")
+    assert not prefetch_ok()               # determinism guard
+    faults.configure("h2d/chunk:0")
+    assert prefetch_ok()                   # non-io sites don't care
+    os.environ["RACON_TPU_INGEST"] = "0"
+    faults.configure(None)
+    assert not prefetch_ok()               # gate off wins
+
+
+# --------------------------------------------------- prefetch overlap
+
+def test_prefetcher_matches_serial_chunks(tmp_path):
+    p = str(tmp_path / "pf.fastq")
+    open(p, "wb").write(FQ_PAYLOAD)
+    serial = [[s.name for s in chunk]
+              for chunk, _ in serial_chunks(FastqParser(p), 700)]
+    pf = IngestPrefetcher(FastqParser(p), 700, "test")
+    try:
+        streamed = [[s.name for s in chunk] for chunk, _ in pf.chunks()]
+    finally:
+        pf.close()
+    assert streamed == serial and sum(map(len, serial)) == 400
+
+
+def test_prefetcher_propagates_parse_error(tmp_path):
+    p = str(tmp_path / "bad.fastq")
+    open(p, "wb").write(b"@a\nACGT\n+\nIIII\nnot a header\n")
+    pf = IngestPrefetcher(FastqParser(p), CHUNK_SIZE, "err")
+    try:
+        with pytest.raises(ParseError, match="malformed FASTQ"):
+            for _chunk in pf.chunks():
+                pass
+    finally:
+        pf.close()
+
+
+def test_prefetcher_close_is_safe_midstream(tmp_path):
+    p = str(tmp_path / "mid.fasta")
+    open(p, "wb").write(FA_PAYLOAD)
+    pf = IngestPrefetcher(FastaParser(p), 100, "abandon")
+    next(iter(pf.chunks()))
+    pf.close()                             # abandons cleanly, no hang
+    pf.close()                             # idempotent
+
+
+# ------------------------------------------------------- merge semantics
+
+def test_ingest_merge_kinds():
+    from racon_tpu.obs import metrics as obs_metrics
+    mk = obs_metrics.merge_kind
+    assert mk("ingest_bytes_in") == obs_metrics.MERGE_SUM
+    assert mk("ingest_inflate_s") == obs_metrics.MERGE_SUM
+    assert mk("ingest_records") == obs_metrics.MERGE_SUM
+    assert mk("ingest_fraction_of_wall") == obs_metrics.MERGE_LAST
+    assert mk("ingest_enabled") == obs_metrics.MERGE_LAST
+
+
+# ------------------------------------------------------ CLI differential
+
+def _cli_inputs(tmp_path, gz=False):
+    rng = np.random.default_rng(7)
+    bases = np.frombuffer(b"ACGT", np.uint8)
+    truth = bases[rng.integers(0, 4, 360)]
+
+    def noisy():
+        out = []
+        for b in truth:
+            r = rng.random()
+            if r < 0.04:
+                continue
+            out.append(int(bases[rng.integers(0, 4)]) if r < 0.08
+                       else int(b))
+        return bytes(out)
+
+    draft = noisy()
+    reads, paf = [], []
+    for i in range(7):
+        r = noisy()
+        reads.append(b">r%d\n%s\n" % (i, r))
+        paf.append(f"r{i}\t{len(r)}\t0\t{len(r)}\t+\tc1\t{len(draft)}"
+                   f"\t0\t{len(draft)}\t{min(len(r), len(draft))}"
+                   f"\t{max(len(r), len(draft))}\t60".encode())
+    files = {"draft.fasta": b">c1\n" + draft + b"\n",
+             "reads.fasta": b"".join(reads),
+             "ovl.paf": b"\n".join(paf) + b"\n"}
+    out = []
+    for name, data in files.items():
+        path = tmp_path / (name + (".gz" if gz else ""))
+        path.write_bytes(gzip.compress(data) if gz else data)
+        out.append(str(path))
+    return out[1], out[2], out[0]          # reads, ovl, draft
+
+
+def _run_cli(reads, ovl, draft):
+    from racon_tpu import cli
+    stdout = io.StringIO()
+    stdout.buffer = io.BytesIO()
+    with contextlib.redirect_stdout(stdout), \
+            contextlib.redirect_stderr(io.StringIO()):
+        rc = cli.main(["--backend", "jax", reads, ovl, draft])
+    assert rc == 0
+    return stdout.buffer.getvalue()
+
+
+def test_cli_gate_differential(tmp_path):
+    """RACON_TPU_INGEST=0 vs =1, plain and gzipped inputs: all four
+    polished FASTAs byte-identical."""
+    plain = _cli_inputs(tmp_path, gz=False)
+    gz = _cli_inputs(tmp_path, gz=True)
+    outs = []
+    for group in (plain, gz):
+        for gate in ("0", "1"):
+            os.environ["RACON_TPU_INGEST"] = gate
+            outs.append(_run_cli(*group))
+    assert outs[0].startswith(b">c1 LN:i:")
+    assert all(o == outs[0] for o in outs)
+
+
+def test_ledger_fleet_gate_differential(tmp_path, monkeypatch):
+    """A 2-shard ledger fleet with the ingest plane on merges
+    byte-identically to the serial gate-off run."""
+    import contextlib as _ctx
+    from racon_tpu import cli
+    from racon_tpu.distributed import ledger as dledger
+    monkeypatch.setenv(dledger.ENV_SHARDS, "2")
+
+    rng = np.random.default_rng(9)
+    bases = np.frombuffer(b"ACGT", np.uint8)
+    drafts, reads, paf = [], [], []
+    for ci in range(2):
+        truth = bases[rng.integers(0, 4, 300)]
+        draft = bytes(truth)
+        drafts.append(b">c%d\n%s\n" % (ci, draft))
+        for i in range(5):
+            idx = rng.random(300) > 0.05
+            r = bytes(truth[idx])
+            name = f"c{ci}r{i}"
+            reads.append(b">%s\n%s\n" % (name.encode(), r))
+            paf.append(f"{name}\t{len(r)}\t0\t{len(r)}\t+\tc{ci}\t300"
+                       f"\t0\t300\t{len(r)}\t300\t60")
+    (tmp_path / "draft.fasta").write_bytes(b"".join(drafts))
+    (tmp_path / "reads.fasta").write_bytes(b"".join(reads))
+    (tmp_path / "ovl.paf").write_text("\n".join(paf) + "\n")
+    args = [str(tmp_path / "reads.fasta"), str(tmp_path / "ovl.paf"),
+            str(tmp_path / "draft.fasta")]
+
+    def run(*extra):
+        stdout = io.StringIO()
+        stdout.buffer = io.BytesIO()
+        with _ctx.redirect_stdout(stdout), \
+                _ctx.redirect_stderr(io.StringIO()):
+            rc = cli.main(["--backend", "jax", *extra, *args])
+        assert rc == 0
+        return stdout.buffer.getvalue()
+
+    os.environ["RACON_TPU_INGEST"] = "0"
+    base = run()
+    os.environ["RACON_TPU_INGEST"] = "1"
+    merged = run("--ledger-dir", str(tmp_path / "ledger"),
+                 "--workers", "2", "--worker-id", "w0")
+    assert merged == base and base.count(b">") == 2
+
+
+@pytest.mark.ava
+def test_ava_config_gate_differential(ref_data):
+    """The kF ava config (reference golden workload) polishes
+    byte-identically with the ingest plane on and off — gzipped FASTQ
+    reads + gzipped ava PAF through the full fragment-correction
+    path."""
+    from racon_tpu.models.polisher import PolisherType, create_polisher
+
+    def run():
+        p = create_polisher(ref_data("sample_reads.fastq.gz"),
+                            ref_data("sample_ava_overlaps.paf.gz"),
+                            ref_data("sample_reads.fastq.gz"),
+                            PolisherType.kF, 500, 10.0, 0.3,
+                            1, -1, -1, backend="native")
+        p.initialize()
+        return [(s.name, bytes(s.data)) for s in p.polish(False)]
+
+    os.environ["RACON_TPU_INGEST"] = "0"
+    serial = run()
+    os.environ["RACON_TPU_INGEST"] = "1"
+    assert run() == serial
+    assert len(serial) == 236
