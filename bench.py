@@ -34,6 +34,7 @@ estimated), so value / 160 rides along as vs_ref_spoa_64t_est.
 
 import json
 import os
+from racon_tpu.utils import envspec
 import sys
 import time
 
@@ -106,8 +107,8 @@ def _ingest_bench() -> dict:
     rng = np.random.default_rng(12)
     line = rng.choice(np.frombuffer(b"ACGT", np.uint8),
                       size=1 << 20).tobytes()
-    n_members = int(os.environ.get("RACON_TPU_BENCH_INGEST_MB", "16"))
-    gate0 = os.environ.get("RACON_TPU_INGEST", "")
+    n_members = int(envspec.read("RACON_TPU_BENCH_INGEST_MB"))
+    gate0 = envspec.read("RACON_TPU_INGEST")
     out: dict = {}
     with tempfile.TemporaryDirectory() as d:
         path = os.path.join(d, "ingest_bench.fasta.gz")
@@ -177,7 +178,7 @@ def main():
     # The registry resets before every rep, so the transfer extras (h2d/
     # d2h bytes, seconds, effective bandwidth) describe exactly the LAST
     # measured run.
-    e2e_reps = max(1, int(os.environ.get("RACON_TPU_BENCH_E2E_REPS", "3")))
+    e2e_reps = max(1, int(envspec.read("RACON_TPU_BENCH_E2E_REPS")))
     e2e_rates = []
     for rep in range(e2e_reps):
         windows = build_windows(n_windows, coverage, wlen)
@@ -274,7 +275,7 @@ def main():
                   n_win=plan.n_win, LA=plan.LA,
                   pallas=_use_pallas(plan.B, plan.Lq, plan.LA),
                   band_w=plan.band_w, rounds=eng.refine_rounds + 1,
-                  adaptive=(os.environ.get("RACON_TPU_ADAPTIVE", "")
+                  adaptive=(envspec.read("RACON_TPU_ADAPTIVE")
                             not in ("0", "false")
                             and eng.refine_rounds + 1 >= 3
                             and len(set(sc[:-1])) <= 1))
@@ -321,7 +322,7 @@ def main():
     # bench rather than silently publishing a record without the curve
     # the caller asked for.
     dp_extras = {}
-    dp_path = os.environ.get("RACON_TPU_BENCH_DP", "")
+    dp_path = envspec.read("RACON_TPU_BENCH_DP")
     if dp_path:
         with open(dp_path, "r", encoding="utf-8") as fh:
             dp_extras = json.load(fh)
@@ -455,7 +456,7 @@ def main():
     # RACON_TPU_BENCH_OUT=<path>: also persist the record durably. The
     # atomic write means a bench killed mid-emission leaves the previous
     # artifact intact rather than a torn JSON file.
-    out_path = os.environ.get("RACON_TPU_BENCH_OUT", "")
+    out_path = envspec.read("RACON_TPU_BENCH_OUT")
     if out_path:
         from racon_tpu.utils.atomicio import atomic_write_text
         atomic_write_text(out_path, json.dumps(out) + "\n")

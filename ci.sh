@@ -17,6 +17,9 @@ cd "$(dirname "$0")"
 FULL=0
 [[ "${1:-}" == "--full" ]] && FULL=1
 
+echo "[ci] contract linter (docs/ANALYSIS.md; fails on non-baselined findings)"
+python scripts/lint.py --ci
+
 if [[ "$FULL" == 1 ]]; then
   echo "[ci] pytest (CPU, 8 virtual devices, FULL incl. ava goldens)"
   python -m pytest tests/ -q -m ''

@@ -1,0 +1,22 @@
+"""Contract linter: static-analysis rules machine-checking the repo's
+cross-cutting invariants (env gates, fault sites, metrics, spans,
+atomic writes, lock discipline, choke points, determinism).
+
+The engine (racon_tpu/analysis/engine.py) walks Python ASTs and emits
+findings; the rules (racon_tpu/analysis/rules.py) each cross-check one
+hand-maintained contract against its machine-readable registry —
+utils/envspec.py, resilience/faults.py SITES, obs/metrics.py
+METRIC_SPECS, scripts/obs_report.py span tables. Driven by
+scripts/lint.py (``--ci`` gates in ci.sh); docs/ANALYSIS.md is the
+rule catalog.
+"""
+
+from racon_tpu.analysis.engine import (Context, Finding, Rule,
+                                       load_baseline, render_json,
+                                       render_text, run_rules,
+                                       split_findings, summary_line)
+from racon_tpu.analysis.rules import ALL_RULES
+
+__all__ = ["ALL_RULES", "Context", "Finding", "Rule", "load_baseline",
+           "render_json", "render_text", "run_rules", "split_findings",
+           "summary_line"]

@@ -168,7 +168,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     enable_compile_cache()
 
     import os as _os
-    metrics_port = _os.environ.get("RACON_TPU_METRICS_PORT", "")
+    from racon_tpu.utils import envspec as _envspec
+    metrics_port = _envspec.read("RACON_TPU_METRICS_PORT")
     if metrics_port:
         # Live OpenMetrics pull endpoint (daemon thread, dies with the
         # process): serves this worker's registry; fleet-wide scrapes
@@ -324,7 +325,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     from racon_tpu.obs.metrics import registry as obs_registry
     rc = 0
 
-    obs_dir = _os.environ.get(fleet.ENV_OBS_DIR, "")
+    obs_dir = _envspec.read(fleet.ENV_OBS_DIR)
     if obs_dir and not args.ledger_dir:
         # Serial runs join the fleet observability plane on request:
         # the same metric shard a ledger worker writes (workers install

@@ -50,6 +50,7 @@ from __future__ import annotations
 
 import json
 import os
+from racon_tpu.utils import envspec
 import signal
 import subprocess
 import sys
@@ -77,7 +78,7 @@ DRAIN_GRACE_S = 5.0
 
 
 def _env_float(name: str, default: float) -> float:
-    raw = os.environ.get(name, "").strip()
+    raw = envspec.read(name).strip()
     if not raw:
         return default
     try:
@@ -153,7 +154,7 @@ def worker_argv(raw_argv: List[str]) -> List[str]:
 
 
 def _load_fault_plan(log) -> List[str]:
-    path = os.environ.get(ENV_FAULT_PLAN, "").strip()
+    path = envspec.read(ENV_FAULT_PLAN).strip()
     if not path:
         return []
     try:

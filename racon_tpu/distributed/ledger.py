@@ -57,6 +57,7 @@ from __future__ import annotations
 
 import json
 import os
+from racon_tpu.utils import envspec
 import re
 import time
 from typing import Dict, Iterator, List, Optional, Tuple, Union
@@ -83,7 +84,7 @@ def split_enabled() -> bool:
     off switch exists so the monster-contig drill (scripts/
     chaos_bench.py --monster) can measure the serialized tail it
     kills."""
-    return os.environ.get(ENV_SPLIT, "1").strip().lower() not in (
+    return envspec.read(ENV_SPLIT).strip().lower() not in (
         "0", "false", "no", "off")
 
 
@@ -107,7 +108,7 @@ def max_split_depth() -> int:
     its remainder to the other the moment the other goes idle — turn
     one shard into a cascade of one-contig claims that is strictly
     slower than never splitting at all."""
-    env = os.environ.get(ENV_SPLIT_DEPTH, "").strip()
+    env = envspec.read(ENV_SPLIT_DEPTH).strip()
     if env:
         try:
             return max(0, int(env))
@@ -122,7 +123,7 @@ def append_event(directory: str, rec: Dict) -> None:
     whole records. The log is advisory (timelines, obs_report), so
     failures are swallowed — module-level so the autoscaler can log
     spawn/retire decisions without holding a ledger."""
-    rec = dict(rec, t=round(time.time(), 3))
+    rec = dict(rec, t=round(time.time(), 3))  # lint: wallclock-ok (advisory event timestamp, not run state)
     data = (json.dumps(rec, sort_keys=True) + "\n").encode()
     try:
         with open(os.path.join(directory, EVENTS_NAME), "ab") as fh:
@@ -273,7 +274,7 @@ class WorkLedger:
                     "[racon_tpu::dist] refusing to open a ledger for "
                     "an empty target set")
             if n_shards is None:
-                env = os.environ.get(ENV_SHARDS, "")
+                env = envspec.read(ENV_SHARDS)
                 if env:
                     n_shards = int(env)
                 else:
@@ -703,7 +704,7 @@ class WorkLedger:
             # the final path (publish_exclusive's tmp+link can't tear,
             # so the drill bypasses it), durable, then hard-exit —
             # readers must treat the torn child as "no split happened".
-            with open(path, "wb") as fh:
+            with open(path, "wb") as fh:  # lint: atomic-ok (torn-write drill)
                 fh.write(blob[:max(1, len(blob) - 9)])
                 fh.flush()
                 os.fsync(fh.fileno())

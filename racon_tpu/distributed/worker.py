@@ -37,6 +37,7 @@ drills can target any phase deterministically.
 from __future__ import annotations
 
 import os
+from racon_tpu.utils import envspec
 import sys
 import time
 from typing import Callable, Optional
@@ -60,7 +61,7 @@ def default_worker_id() -> str:
 
 
 def _poll_interval(lease_s: float) -> float:
-    env = os.environ.get(ENV_POLL, "")
+    env = envspec.read(ENV_POLL)
     if env:
         return max(0.01, float(env))
     # Often enough to steal promptly after expiry, rare enough that an
@@ -73,12 +74,12 @@ def _avoid_shards() -> list:
     seeded by the autoscaler when replacing a self-evicted worker, so
     the replacement doesn't immediately re-claim the assignment that
     wedged its predecessor."""
-    env = os.environ.get(ENV_AVOID, "")
+    env = envspec.read(ENV_AVOID)
     return [s for s in (p.strip() for p in env.split(",")) if s]
 
 
 def _split_after_s(lease_s: float) -> float:
-    env = os.environ.get(ENV_SPLIT_AFTER, "").strip()
+    env = envspec.read(ENV_SPLIT_AFTER).strip()
     if env:
         try:
             return max(0.0, float(env))

@@ -51,6 +51,7 @@ from __future__ import annotations
 import gzip
 import mmap
 import os
+from racon_tpu.utils import envspec
 import threading
 import time
 import zlib
@@ -74,7 +75,7 @@ def inflate_workers() -> int:
     """Inflate pool width: ``RACON_TPU_INGEST_WORKERS`` or a core-count
     default (capped — inflate saturates memory bandwidth long before it
     needs every core of a large host)."""
-    env = os.environ.get(ENV_WORKERS, "")
+    env = envspec.read(ENV_WORKERS)
     if env:
         try:
             n = int(env)

@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import mmap
 import os
+from racon_tpu.utils import envspec
 import threading
 from typing import Iterator, List, Optional, Tuple
 
@@ -57,7 +58,7 @@ def ingest_enabled() -> bool:
     """The ingest-subsystem gate: default ON, ``RACON_TPU_INGEST=0``
     (or ``false``) is the serial escape hatch — mirror image of the
     pipeline gate, which defaults off."""
-    return os.environ.get(ENV_INGEST, "") not in ("0", "false")
+    return envspec.read(ENV_INGEST) not in ("0", "false")
 
 
 def prefetch_ok() -> bool:

@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import json
 import os
+from racon_tpu.utils import envspec
 import threading
 import time
 from typing import Dict, List, Optional
@@ -74,7 +75,7 @@ DEFAULT_STRAGGLER_FRAC = 0.5
 
 
 def straggler_frac() -> float:
-    env = os.environ.get(ENV_STRAGGLER_FRAC, "").strip()
+    env = envspec.read(ENV_STRAGGLER_FRAC).strip()
     if not env:
         return DEFAULT_STRAGGLER_FRAC
     try:
@@ -110,7 +111,7 @@ def shard_path(directory: str, worker_id: str) -> str:
 
 
 def flush_interval() -> float:
-    env = os.environ.get(ENV_FLUSH_S, "")
+    env = envspec.read(ENV_FLUSH_S)
     if env:
         try:
             return max(0.0, float(env))
@@ -194,7 +195,7 @@ class WorkerMetricsWriter:
                 # then die without cleanup. The aggregator must recover
                 # every record before the tear.
                 torn = data[:max(1, len(data) - 17)]
-                with open(self.path, "wb") as fh:
+                with open(self.path, "wb") as fh:  # lint: atomic-ok (torn-write drill)
                     fh.write(torn)
                     fh.flush()
                     os.fsync(fh.fileno())

@@ -52,7 +52,7 @@ class MetricsRegistry:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._v: Dict[str, object] = {}
+        self._v: Dict[str, object] = {}   # guarded-by: _lock
 
     def inc(self, key: str, value: float = 1) -> None:
         with self._lock:
@@ -618,6 +618,92 @@ def merge_kind(key: str) -> str:
     if key.endswith("_peak"):
         return MERGE_MAX
     return MERGE_SUM
+
+
+#: Declared metric contract — one row per registry key family, the
+#: ground truth the metrics-contract lint rule (racon_tpu/analysis,
+#: MET001–MET004) checks against the recorded keys, merge_kind(), and
+#: the docs/OBSERVABILITY.md producer table. Each row is
+#: ``(pattern, merge kind, doc token)``: ``*`` in a pattern matches one
+#: runtime-named segment (site slug, stage name, phase slug); the doc
+#: token must appear verbatim in docs/OBSERVABILITY.md. Exact keys
+#: sort before the wildcard that would shadow them. ``_``-prefixed
+#: keys are internal (excluded from snapshots) and carry no row.
+METRIC_SPECS = (
+    ("adaptive_early_exits", MERGE_SUM, "adaptive_early_exits"),
+    ("adaptive_rounds_executed", MERGE_SUM, "adaptive_rounds_executed"),
+    ("adaptive_rounds_scheduled", MERGE_SUM, "adaptive_rounds_scheduled"),
+    ("align_phase_seconds", MERGE_SUM, "align_phase_seconds"),
+    ("d2h_bytes", MERGE_SUM, "d2h_bytes"),
+    ("d2h_s", MERGE_SUM, "d2h_s"),
+    ("d2h_transfers", MERGE_SUM, "d2h_transfers"),
+    ("device_dispatches", MERGE_SUM, "device_dispatches"),
+    ("dist_n_targets", MERGE_LAST, "dist_n_targets"),
+    ("dist_shards", MERGE_LAST, "dist_shards"),
+    ("dist_workers", MERGE_LAST, "dist_workers"),
+    ("dist_*", MERGE_SUM, "dist_claims"),
+    ("fleet_target_workers", MERGE_LAST, "fleet_target_workers"),
+    ("h2d_bytes", MERGE_SUM, "h2d_bytes"),
+    ("h2d_s", MERGE_SUM, "h2d_s"),
+    ("h2d_transfers", MERGE_SUM, "h2d_transfers"),
+    ("ingest_blocks", MERGE_SUM, "ingest_blocks"),
+    ("ingest_bytes_in", MERGE_SUM, "ingest_bytes_in"),
+    ("ingest_bytes_out", MERGE_SUM, "ingest_bytes_out"),
+    ("ingest_enabled", MERGE_LAST, "ingest_enabled"),
+    ("ingest_fraction_of_wall", MERGE_LAST, "ingest_fraction_of_wall"),
+    ("ingest_inflate_s", MERGE_SUM, "ingest_inflate_s"),
+    ("ingest_parse_s", MERGE_SUM, "ingest_parse_s"),
+    ("ingest_raw_bytes", MERGE_SUM, "ingest_raw_bytes"),
+    ("ingest_records", MERGE_SUM, "ingest_records"),
+    ("ingest_wait_s", MERGE_SUM, "ingest_wait_s"),
+    ("jax_cache_enabled", MERGE_LAST, "jax_cache_enabled"),
+    ("jax_cache_entries_added", MERGE_LAST, "jax_cache_entries_added"),
+    ("jax_cache_entries_start", MERGE_LAST, "jax_cache_entries_start"),
+    ("ovl_device_fraction", MERGE_LAST, "ovl_device_fraction"),
+    ("ovl_device_jobs", MERGE_SUM, "ovl_device_jobs"),
+    ("ovl_native_jobs", MERGE_SUM, "ovl_native_jobs"),
+    ("ovl_tiles_exec", MERGE_SUM, "ovl_tiles_exec"),
+    ("phase_seconds_*", MERGE_SUM, "phase_seconds_"),
+    ("pipe_overlap_efficiency", MERGE_LAST, "pipe_overlap_efficiency"),
+    ("pipe_queue_*_get_wait_s", MERGE_SUM, "pipe_queue_"),
+    ("pipe_queue_*_peak", MERGE_MAX, "pipe_queue_"),
+    ("pipe_queue_*_put_wait_s", MERGE_SUM, "pipe_queue_"),
+    ("pipe_runs", MERGE_SUM, "pipe_runs"),
+    ("pipe_stage_*_busy_s", MERGE_SUM, "pipe_stage_"),
+    ("pipe_stage_*_items", MERGE_SUM, "pipe_stage_"),
+    ("pipe_stage_*_stall_in_s", MERGE_SUM, "pipe_stage_"),
+    ("pipe_stage_*_stall_out_s", MERGE_SUM, "pipe_stage_"),
+    ("pipe_stall_events", MERGE_SUM, "pipe_stall_events"),
+    ("pipe_wall_s", MERGE_SUM, "pipe_wall_s"),
+    ("poa_windows_total", MERGE_SUM, "poa_windows_total"),
+    ("redo_device_windows", MERGE_SUM, "redo_device_windows"),
+    ("redo_host_windows", MERGE_SUM, "redo_host_windows"),
+    ("redo_passes", MERGE_SUM, "redo_passes"),
+    ("res_ckpt_*", MERGE_SUM, "res_ckpt_commits"),
+    ("res_degraded_chunks", MERGE_SUM, "res_degraded_chunks"),
+    ("res_degraded_windows", MERGE_SUM, "res_degraded_windows"),
+    ("res_fault_injected_total", MERGE_SUM, "res_fault_injected_total"),
+    ("res_fault_site_*", MERGE_SUM, "res_fault_site_"),
+    ("res_retry_backoff_s", MERGE_SUM, "res_retry_backoff_s"),
+    ("res_retry_exhausted", MERGE_SUM, "res_retry_exhausted"),
+    ("res_retry_site_*", MERGE_SUM, "res_retry_site_"),
+    ("res_retry_total", MERGE_SUM, "res_retry_total"),
+    ("res_watchdog_breach_total", MERGE_SUM, "res_watchdog_breach_total"),
+    ("res_watchdog_site_*", MERGE_SUM, "res_watchdog_site_"),
+    ("res_watchdog_terminal_total", MERGE_SUM,
+     "res_watchdog_terminal_total"),
+    ("sched_dispatches_saved", MERGE_LAST, "sched_"),
+    ("sched_flag_pull_s", MERGE_SUM, "sched_flag_pull_s"),
+    ("sched_flag_pulls", MERGE_SUM, "sched_flag_pulls"),
+    ("sched_repack_overhead_s", MERGE_LAST, "sched_"),
+    ("sched_rounds", MERGE_LAST, "sched_"),
+    ("sched_rounds_hist", MERGE_LAST, "sched_"),
+    ("sched_rounds_saved_frac", MERGE_LAST, "sched_"),
+    ("sched_survivor_frac", MERGE_LAST, "sched_"),
+    ("sched_chunks", MERGE_LAST, "sched_"),
+    ("sched_windows", MERGE_LAST, "sched_"),
+    ("walk_chain_len", MERGE_LAST, "walk_chain_len"),
+)
 
 
 def merge_values(key: str, values) -> object:

@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import json
 import os
+from racon_tpu.utils import envspec
 import threading
 import time
 from typing import Optional
@@ -149,17 +150,18 @@ class Tracer:
         self._t0 = time.perf_counter()
         self._lock = threading.Lock()
         self._local = threading.local()
-        self._next_id = 0
+        self._next_id = 0                 # guarded-by: _lock
         # Process-wide span attributes (worker_id/shard/run_fp) merged
         # into every span record; explicit span attrs win on key clash.
-        self._context: dict = {}
-        self._xprof = os.environ.get(ENV_XPROF, "") not in ("", "0",
+        self._context: dict = {}          # guarded-by: _lock
+        self._xprof = envspec.read(ENV_XPROF) not in ("", "0",
                                                             "false")
         # Spans stream to a ``.part`` sidecar; finish() promotes it to
         # ``path`` atomically, so readers of ``path`` never observe a
         # half-written trace (a killed run leaves only the sidecar).
         self._part = path + ".part"
-        self._fh = open(self._part, "w", encoding="utf-8")
+        self._fh = open(self._part, "w",  # lint: atomic-ok (streamed sidecar; finish() promotes via atomic_finalize)
+                        encoding="utf-8")
         self._write({"ev": "begin", "schema": SCHEMA_VERSION,
                      "unix_time": time.time()})
 
@@ -278,7 +280,7 @@ def configure(path: Optional[str] = None):
     empty/unset keeps tracing disabled. Idempotent for the same path;
     a new path replaces (and closes) the previous tracer."""
     global _tracer
-    path = path or os.environ.get(ENV_TRACE, "")
+    path = path or envspec.read(ENV_TRACE)
     if not path:
         if _tracer is None:
             _tracer = NULL

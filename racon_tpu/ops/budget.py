@@ -42,6 +42,7 @@ default 4) caps the selection; 2 reproduces the PR 5 behavior exactly.
 from __future__ import annotations
 
 import os
+from racon_tpu.utils import envspec
 
 # Constraint (1): flat gather/scatter indices are int32 on device.
 INT32_INDEX_ELEMS = 2 ** 31
@@ -95,7 +96,7 @@ def walk_k_env() -> int:
     quad-column), 2 (PR 5 dual-column), or 1 (single-step reference).
     Anything else is a hard error — a typo silently degrading the walk
     would be invisible until a profile regression."""
-    raw = os.environ.get(WALK_K_ENV, "").strip()
+    raw = envspec.read(WALK_K_ENV).strip()
     if not raw:
         return 4
     try:
@@ -267,7 +268,7 @@ _DEADLINE_CELLS_DEFAULT = 2e6
 
 
 def _deadline_env(name: str, default: float) -> float:
-    raw = os.environ.get(name, "").strip()
+    raw = envspec.read(name).strip()
     if not raw:
         return default
     try:

@@ -39,6 +39,7 @@ from racon_tpu.ops.encode import ALPHABET
 from racon_tpu.ops import flat as flatmod
 from racon_tpu.ops.flat import PAD_OP
 from racon_tpu.ops.budget import max_dir_elems
+from racon_tpu.utils import envspec
 
 # Per-lane-tensor element budget for the dirs/nxt planes (the column
 # walk's flat gather index and the HBM single-buffer ceiling). Derived
@@ -321,7 +322,7 @@ def _use_pallas(B: int, Lq: int, LA: int) -> bool:
     import os
     import jax
     from racon_tpu.ops.pallas.flat_kernel import TB, CH
-    if os.environ.get("RACON_TPU_NO_PALLAS", "") not in ("", "0", "false"):
+    if envspec.read("RACON_TPU_NO_PALLAS") not in ("", "0", "false"):
         return False                               # debug/safety valve
     if jax.default_backend() not in ("tpu", "axon"):
         return False
@@ -881,7 +882,7 @@ def dispatch_chunk(plan: ChunkPlan, *, match: int, mismatch: int,
     import jax
     import jax.numpy as jnp
 
-    verbose = os.environ.get("RACON_TPU_TIMING", "") not in ("", "0")
+    verbose = envspec.read("RACON_TPU_TIMING") not in ("", "0")
     collect = stats is not None or verbose
 
     def sync(x, tag, t0):
@@ -897,7 +898,7 @@ def dispatch_chunk(plan: ChunkPlan, *, match: int, mismatch: int,
 
     ndp = mesh.shape["dp"] if mesh is not None else 1
     pallas = _use_pallas(plan.B // ndp, plan.Lq, plan.LA)
-    band_w = (0 if os.environ.get("RACON_TPU_NO_BAND", "")
+    band_w = (0 if envspec.read("RACON_TPU_NO_BAND")
               not in ("", "0", "false") else plan.band_w)
     # Walk depth for this chunk's banded forwards. Selected at the
     # round-0 (widest) band so every round of the chunk shares one k:
@@ -930,7 +931,7 @@ def dispatch_chunk(plan: ChunkPlan, *, match: int, mismatch: int,
         # schedule satisfies this by construction).
         sc = ins_scale if isinstance(ins_scale, tuple) \
             else (ins_scale,) * rounds
-        adaptive = (os.environ.get("RACON_TPU_ADAPTIVE", "")
+        adaptive = (envspec.read("RACON_TPU_ADAPTIVE")
                     not in ("0", "false")
                     and rounds >= 3 and len(set(sc[:-1])) <= 1)
         from racon_tpu.ops.budget import dispatch_deadline_s
@@ -957,21 +958,37 @@ def dispatch_chunk(plan: ChunkPlan, *, match: int, mismatch: int,
     # round's wall time stays attributable (RACON_TPU_TIMING=1).
     host_args = (plan.bb, plan.bbw, plan.alen, plan.begin, plan.end,
                  plan.q, plan.qw8, plan.lq, plan.w_read, plan.win)
-    t_put = time.perf_counter()
     if mesh is None:
         rnd = device_round
-        dev_args = jax.device_put(host_args)
     else:
-        from jax.sharding import NamedSharding, PartitionSpec
         rnd = functools.partial(device_round_sharded, mesh=mesh)
-        rep = NamedSharding(mesh, PartitionSpec())
-        job = NamedSharding(mesh, PartitionSpec("dp"))
-        shardings = (rep, rep, rep, job, job, job, job, job, job, job)
-        dev_args = tuple(jax.device_put(a, s)
-                         for a, s in zip(host_args, shardings))
+
+    def _put():
+        t_put = time.perf_counter()
+        if mesh is None:
+            out = jax.device_put(host_args)
+        else:
+            from jax.sharding import NamedSharding, PartitionSpec
+            rep = NamedSharding(mesh, PartitionSpec())
+            job = NamedSharding(mesh, PartitionSpec("dp"))
+            shardings = (rep, rep, rep, job, job, job, job, job, job,
+                         job)
+            out = tuple(jax.device_put(a, s)
+                        for a, s in zip(host_args, shardings))
+        record_h2d(sum(a.nbytes for a in host_args),
+                   time.perf_counter() - t_put, name="h2d/chunk")
+        return out
+
+    # Same watchdog/retry envelope as the packed path: the verbose
+    # timing path must not reopen the unguarded-transfer hole that
+    # fail-slow hardening closed (choke-point rule CHK001).
+    from racon_tpu.resilience.retry import call as retry_call
+    from racon_tpu.ops.budget import transfer_deadline_s
+    dev_args = retry_call(
+        "h2d/chunk", _put,
+        deadline_s=transfer_deadline_s(
+            sum(a.nbytes for a in host_args), "h2d"))
     bb, bbw, alen, begin, end, q, qw8, lq, w_read, win = dev_args
-    record_h2d(sum(a.nbytes for a in host_args),
-               time.perf_counter() - t_put, name="h2d/chunk")
     t0 = sync(alen, "h2d", t0)
     cov = None
     ovf = jnp.zeros(plan.n_win, dtype=bool)

@@ -38,6 +38,7 @@ from typing import List, Optional, Tuple
 
 import functools
 import os
+from racon_tpu.utils import envspec
 
 import numpy as np
 
@@ -371,7 +372,7 @@ def device_breaking_points(pending, sequences, window_length: int, *,
     from racon_tpu.ops.encode import encode_bases
 
     tracer = _trace.get_tracer()
-    tiled_on = os.environ.get("RACON_TPU_OVL_TILED", "1") != "0"
+    tiled_on = envspec.read("RACON_TPU_OVL_TILED") != "0"
     jobs = []        # (overlap, q_codes, t_codes, q_start)
     tiled_jobs = []  # (overlap, q_codes, t_codes, q_start, plan)
     fallback = []
@@ -470,7 +471,7 @@ def device_breaking_points(pending, sequences, window_length: int, *,
     # otherwise serializes with device time).
     import sys as _sys
     import time as _time
-    verbose = os.environ.get("RACON_TPU_TIMING", "") not in ("", "0")
+    verbose = envspec.read("RACON_TPU_TIMING") not in ("", "0")
     t_disp = _time.perf_counter()
     pending_out = []
     from racon_tpu.ops.budget import walk_k_for

@@ -254,8 +254,8 @@ class PoaEngine:
         # (B x Lq x W) whenever every chunk will band: size chunks by
         # the run-level band width then, not the full LA — about 2x more
         # jobs per dispatch at w=500 geometry.
-        import os as _os
-        band_off = (_os.environ.get("RACON_TPU_NO_BAND", "")
+        from racon_tpu.utils import envspec as _envspec
+        band_off = (_envspec.read("RACON_TPU_NO_BAND")
                     not in ("", "0", "false"))
         w_run = self._run_band_width(active, la_cap)
         dirs_cols = la_cap if (band_off or not w_run) else w_run

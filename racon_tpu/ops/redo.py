@@ -38,6 +38,7 @@ flagged window host-repolishes). Counters: obs record_redo publishes
 from __future__ import annotations
 
 import os
+from racon_tpu.utils import envspec
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -49,7 +50,7 @@ def redo_enabled() -> bool:
     """The wide-band device redo is on unless RACON_TPU_REDO=0 (the
     host consensus redo is the fallback either way — off just means
     every flagged window takes it)."""
-    return os.environ.get(REDO_ENV, "") not in ("0", "false")
+    return envspec.read(REDO_ENV) not in ("0", "false")
 
 
 def _widen(plan) -> None:

@@ -31,6 +31,7 @@ stage diagram and failure semantics).
 from __future__ import annotations
 
 import os
+from racon_tpu.utils import envspec
 from typing import Optional
 
 ENV_PIPELINE = "RACON_TPU_PIPELINE"
@@ -61,7 +62,7 @@ def configure(depth: Optional[int]) -> None:
 
 def pipeline_enabled() -> bool:
     """Streaming pipeline gate (module docstring has the truth table)."""
-    env = os.environ.get(ENV_PIPELINE, "")
+    env = envspec.read(ENV_PIPELINE)
     if env in ("0", "false"):
         return False
     if _cli_depth is not None:
@@ -73,7 +74,7 @@ def pipeline_depth() -> int:
     """Bounded-queue capacity (in-flight chunks per stage edge)."""
     if _cli_depth is not None and _cli_depth > 0:
         return _cli_depth
-    env = os.environ.get(ENV_DEPTH, "")
+    env = envspec.read(ENV_DEPTH)
     if env:
         try:
             d = int(env)

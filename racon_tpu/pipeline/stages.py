@@ -32,6 +32,7 @@ efficiency — device-busy over wall — falls out of these numbers
 from __future__ import annotations
 
 import os
+from racon_tpu.utils import envspec
 import sys
 import threading
 import time
@@ -48,7 +49,7 @@ _STALL_DEFAULT_S = 300.0
 
 
 def stall_window_s() -> float:
-    txt = os.environ.get(ENV_STALL, "").strip()
+    txt = envspec.read(ENV_STALL).strip()
     if not txt:
         return _STALL_DEFAULT_S
     try:

@@ -81,6 +81,7 @@ from __future__ import annotations
 
 import hashlib
 import os
+from racon_tpu.utils import envspec
 import signal
 import threading
 from typing import Dict, List, Optional, Tuple
@@ -88,6 +89,27 @@ from typing import Dict, List, Optional, Tuple
 ENV_FAULTS = "RACON_TPU_FAULTS"
 
 _ACTIONS = ("raise", "kill", "term", "int", "torn", "hang", "stall")
+
+#: Declared fault-site table — the ground truth the fault-site lint
+#: rule (racon_tpu/analysis, FLT001/FLT002) checks both ways: every
+#: literal passed to maybe_fault/maybe_torn/retry.call must be listed
+#: here, and every listed site must be exercised by at least one test
+#: or smoke script. Keep alphabetical.
+SITES = (
+    "ckpt/commit", "ckpt/manifest",
+    "d2h/align", "d2h/chunk", "d2h/sp",
+    "dispatch/chunk",
+    "dist/claim", "dist/contig", "dist/merge", "dist/merge_write",
+    "dist/shard", "dist/split",
+    "h2d/align", "h2d/chunk", "h2d/repack",
+    "io/inflate", "io/read",
+    "obs/snapshot",
+    "sched/flags",
+)
+
+#: Dynamic site families: one entry per prefix; the concrete site is
+#: prefix + a runtime name (pipeline stage names, pipe/<stage>).
+SITE_PREFIXES = ("pipe/",)
 
 #: Fallback sleep for ``stall`` with no explicit duration, seconds.
 ENV_STALL_S = "RACON_TPU_FAULT_STALL_S"
@@ -214,8 +236,8 @@ class FaultInjector:
         self.seed = parsed_seed if seed is None else int(seed)
         self.spec = spec
         self._lock = threading.Lock()
-        self._counts: Dict[str, int] = {}
-        self.fired: List[Tuple[str, int, str]] = []
+        self._counts: Dict[str, int] = {}   # guarded-by: _lock
+        self.fired: List[Tuple[str, int, str]] = []  # guarded-by: _lock
 
     def sites(self) -> Tuple[str, ...]:
         return tuple(sorted(self._rules))
@@ -282,7 +304,7 @@ class FaultInjector:
         import time as _time
         if action == "stall":
             if duration is None:
-                duration = float(os.environ.get(ENV_STALL_S, "") or
+                duration = float(envspec.read(ENV_STALL_S) or
                                  _STALL_DEFAULT_S)
             _time.sleep(duration)
             return
@@ -290,7 +312,7 @@ class FaultInjector:
             from racon_tpu.resilience.watchdog import ambient_deadline
             armed = ambient_deadline()
             duration = 2.0 * armed if armed > 0 else \
-                float(os.environ.get(ENV_HANG_S, "") or _HANG_DEFAULT_S)
+                float(envspec.read(ENV_HANG_S) or _HANG_DEFAULT_S)
         _time.sleep(duration)
 
     def counts(self) -> Dict[str, int]:
@@ -316,7 +338,7 @@ def get_injector() -> Optional[FaultInjector]:
     """The active injector, arming lazily from ``RACON_TPU_FAULTS``."""
     global _INJECTOR, _ARMED
     if not _ARMED:
-        spec = os.environ.get(ENV_FAULTS, "")
+        spec = envspec.read(ENV_FAULTS)
         _INJECTOR = FaultInjector(spec) if spec else None
         _ARMED = True
     return _INJECTOR

@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import hashlib
 import os
+from racon_tpu.utils import envspec
 import time
 from typing import Callable, Optional, Tuple
 
@@ -141,7 +142,7 @@ def default_policy() -> RetryPolicy:
     global _DEFAULT
     if _DEFAULT is None:
         kw = {}
-        spec = os.environ.get(ENV_RETRY, "")
+        spec = envspec.read(ENV_RETRY)
         for part in filter(None, (p.strip() for p in spec.split(","))):
             try:
                 key, val = part.split("=", 1)

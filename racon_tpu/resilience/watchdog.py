@@ -37,6 +37,7 @@ caller does not.
 from __future__ import annotations
 
 import os
+from racon_tpu.utils import envspec
 import threading
 import time
 from typing import Callable, Dict, List, Optional
@@ -84,7 +85,7 @@ class WatchdogTerminal(RuntimeError):
 
 def terminal_limit() -> int:
     """Breach count at which a breach becomes terminal; 0 disables."""
-    txt = os.environ.get(ENV_TERMINAL, "")
+    txt = envspec.read(ENV_TERMINAL)
     if not txt:
         return 0
     try:

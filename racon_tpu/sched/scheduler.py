@@ -30,6 +30,7 @@ to the unbounded host redo, so further device rounds are wasted work.
 from __future__ import annotations
 
 import os
+from racon_tpu.utils import envspec
 import time
 from typing import List, Optional, Sequence, Tuple
 
@@ -45,7 +46,7 @@ from racon_tpu.sched.telemetry import SchedTelemetry
 def sched_enabled() -> bool:
     """Convergence scheduling is on unless RACON_TPU_SCHED=0 (the
     fixed-round single-dispatch engine is the fallback)."""
-    return os.environ.get("RACON_TPU_SCHED", "") not in ("0", "false")
+    return envspec.read("RACON_TPU_SCHED") not in ("0", "false")
 
 
 class ConvergenceScheduler:
@@ -115,7 +116,7 @@ class ConvergenceScheduler:
         R = self.rounds
         telem = self.telemetry
         ndp = self.mesh.shape["dp"] if self.mesh is not None else 1
-        band_w = (0 if os.environ.get("RACON_TPU_NO_BAND", "")
+        band_w = (0 if envspec.read("RACON_TPU_NO_BAND")
                   not in ("", "0", "false") else plan.band_w)
         # Same per-chunk walk-depth selection as dispatch_chunk: pick k
         # at the round-0 (widest) band so every dispatch shares one k.
@@ -217,7 +218,7 @@ class ConvergenceScheduler:
                 # per-round flag pull); the adaptive while_loop form
                 # stops its device loop at the chunk's fixed point
                 # instead of always running all R - executed rounds.
-                adapt = (os.environ.get("RACON_TPU_ADAPTIVE", "")
+                adapt = (envspec.read("RACON_TPU_ADAPTIVE")
                          not in ("0", "false")
                          and len(tail_ws) >= 2
                          and len(set(tail_ws)) == 1)

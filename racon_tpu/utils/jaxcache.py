@@ -19,6 +19,7 @@ entry is a compile this process paid for (a warm run adds none).
 from __future__ import annotations
 
 import os
+from racon_tpu.utils import envspec
 
 from racon_tpu.obs.metrics import registry as _obs_registry
 
@@ -33,7 +34,7 @@ def cache_entry_count(path: str) -> int:
 
 def enable_compile_cache(path: str | None = None) -> None:
     """Enable the cache (idempotent, safe before or after jax import)."""
-    env = os.environ.get("RACON_TPU_JAX_CACHE", "")
+    env = envspec.read("RACON_TPU_JAX_CACHE")
     reg = _obs_registry()
     if env in ("0", "false", "off"):
         reg.set("jax_cache_enabled", 0)

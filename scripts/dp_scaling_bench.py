@@ -37,6 +37,7 @@ from typing import List, Optional
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+from racon_tpu.utils import envspec
 
 import numpy as np                                   # noqa: E402
 
@@ -146,7 +147,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         del argv[i:i + 2]
     contig_len = int(argv[argv.index("--contig-len") + 1]) \
         if "--contig-len" in argv else 300
-    timeout_s = float(os.environ.get("RACON_TPU_DP_TIMEOUT", "600"))
+    timeout_s = float(envspec.read("RACON_TPU_DP_TIMEOUT"))
 
     ncpu = os.cpu_count() or 1
     if counts_arg == "auto":

@@ -61,6 +61,14 @@ KIND_REQUIRED_ATTRS = {
     "ingest": ("mode", "bytes"),
 }
 
+# Span kinds that carry no required attributes — structural intervals
+# whose payload is just name + duration. Together with
+# KIND_REQUIRED_ATTRS this is the closed set of legal span kinds: the
+# span-schema lint rule (racon_tpu/analysis, SPAN001–SPAN003) checks
+# every Tracer emission against the union, both directions.
+ATTR_FREE_KINDS = ("chunk", "dispatch", "phase", "pipeline", "round",
+                   "run")
+
 # Span intervals are rounded to 1e-6 on write and a parent's clock stops
 # fractionally after its children's; allow that much slack in nesting.
 EPS = 5e-3

@@ -17,6 +17,7 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from racon_tpu.utils import envspec
 
 import numpy as np
 
@@ -137,7 +138,7 @@ def main():
         print(f"{upto:6s}: {dt:.3f}s (+{dt - prev:.3f}s)", flush=True)
         prev = dt
 
-    trace_dir = os.environ.get("RACON_TPU_TRACE")
+    trace_dir = envspec.read("RACON_TPU_TRACE")
     if trace_dir:
         from racon_tpu.ops.poa import PoaEngine
         eng = PoaEngine(backend="jax")

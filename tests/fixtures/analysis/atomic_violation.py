@@ -1,0 +1,9 @@
+"""Seeded atomic-write violation (lint fixture — never imported).
+
+ATM001: a bare write-mode open in durable-output code, no pragma.
+"""
+
+
+def save(path, data):
+    with open(path, "w") as fh:                           # ATM001
+        fh.write(data)

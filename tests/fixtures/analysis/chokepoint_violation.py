@@ -1,0 +1,10 @@
+"""Seeded choke-point violation (lint fixture — never imported).
+
+CHK001: jax.device_put outside any retry/watchdog-guarded closure.
+"""
+
+import jax
+
+
+def ship(host_buf):
+    return jax.device_put(host_buf)                       # CHK001

@@ -1,0 +1,11 @@
+"""Seeded determinism violation (lint fixture — never imported).
+
+DET001: wallclock/PRNG inside an identity (fingerprint) path.
+"""
+
+import random
+import time
+
+
+def shard_fingerprint(path):
+    return f"{path}:{time.time()}:{random.random()}"      # DET001 x2

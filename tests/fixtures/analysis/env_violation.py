@@ -1,0 +1,15 @@
+"""Seeded env-contract violations (lint fixtures — never imported).
+
+ENV001: a raw environ read of a RACON_TPU_ name outside envspec.
+ENV002: envspec.read of a gate nobody declared.
+"""
+
+import os
+
+from racon_tpu.utils import envspec
+
+MODE = os.environ.get("RACON_TPU_FIXTURE_MODE", "")       # ENV001
+
+
+def ghost():
+    return envspec.read("RACON_TPU_FIXTURE_GHOST")        # ENV002

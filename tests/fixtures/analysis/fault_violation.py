@@ -1,0 +1,10 @@
+"""Seeded fault-site violation (lint fixture — never imported).
+
+FLT001: a hook literal that faults.SITES does not declare.
+"""
+
+from racon_tpu.resilience.faults import maybe_fault
+
+
+def hook():
+    maybe_fault("ghost/site")                             # FLT001

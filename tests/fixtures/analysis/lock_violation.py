@@ -1,0 +1,22 @@
+"""Seeded lock-discipline violation (lint fixture — never imported).
+
+LCK001: a guarded-by attribute mutated outside its lock.
+"""
+
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0  # guarded-by: _lock
+        self.tags = []  # guarded-by: _lock
+
+    def bump_unlocked(self):
+        self.n += 1                                       # LCK001
+        self.tags.append("x")                             # LCK001
+
+    def bump_locked(self):
+        with self._lock:
+            self.n += 1                                   # clean
+            self.tags.append("y")

@@ -1,0 +1,11 @@
+"""Seeded metrics-contract violation (lint fixture — never imported).
+
+MET001: a recorded key matching no METRIC_SPECS row.
+"""
+
+from racon_tpu.obs.metrics import registry
+
+
+def bump():
+    registry().inc("zz_ghost_total")                      # MET001
+    registry().set(f"zz_ghost_{int(1)}_gauge", 1.0)       # MET001 (dyn)

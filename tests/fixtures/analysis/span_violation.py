@@ -1,0 +1,12 @@
+"""Seeded span-schema violations (lint fixture — never imported).
+
+SPAN001: an emitted kind the obs_report.py validators don't know.
+SPAN002: a known kind emitted without its required attrs.
+"""
+
+
+def run(tracer):
+    with tracer.span("ghost_kind", "x"):                  # SPAN001
+        pass
+    with tracer.span("transfer", "h2d", note=1):          # SPAN002
+        pass

@@ -41,6 +41,22 @@ def dist_sandbox(monkeypatch):
 # ------------------------------------------------------------ partition
 
 
+def test_fault_site_dist_claim_injects_then_claims(tmp_path):
+    """The declared dist/claim injection site is live: a one-shot fault
+    surfaces from the first claim attempt and the retried claim wins
+    the shard normally (lint rule FLT002 requires every declared site
+    to be exercised)."""
+    d = str(tmp_path / "ledger")
+    led = WorkLedger.open(d, "fp1", n_targets=4, workers=2)
+    faults.configure("dist/claim:0")
+    with pytest.raises(faults.InjectedFault):
+        led.claim_shard("w0")
+    claim = led.claim_shard("w0")
+    assert claim is not None and claim.worker == "w0"
+    snap = obs_metrics.registry().snapshot()
+    assert snap["res_fault_injected_total"] == 1
+
+
 def test_partition_bounds_balanced():
     assert dledger._partition(6, 3) == [0, 2, 4, 6]
     assert dledger._partition(7, 3) == [0, 3, 5, 7]

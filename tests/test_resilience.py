@@ -372,6 +372,34 @@ def test_degradation_to_host_is_bit_identical():
     assert snap["res_degraded_windows"] >= 1
 
 
+def test_verbose_timing_path_h2d_retry_envelope(monkeypatch):
+    """Pin the choke-point fix: the RACON_TPU_TIMING=1 per-round path
+    shipped its arrays through a bare jax.device_put with no
+    fault/retry/deadline envelope, so a transfer fault there bypassed
+    the whole resilience layer. Now the upload retries like the packed
+    path: a one-shot h2d/chunk fault is absorbed, output unchanged."""
+    from racon_tpu.ops.poa import PoaEngine
+
+    clean = _build_windows(6, seed=7)
+    PoaEngine(backend="jax", log=io.StringIO()).consensus_windows(clean)
+    obs_metrics.reset()
+
+    monkeypatch.setenv("RACON_TPU_TIMING", "1")
+    retry.configure(retry.RetryPolicy(attempts=3, base=0.0, jitter=0.0))
+    faults.configure("h2d/chunk:0")
+    timed = _build_windows(6, seed=7)
+    with contextlib.redirect_stderr(io.StringIO()):
+        PoaEngine(backend="jax",
+                  log=io.StringIO()).consensus_windows(timed)
+
+    assert [w.consensus for w in timed] == \
+        [w.consensus for w in clean]
+    snap = obs_metrics.registry().snapshot()
+    assert snap["res_fault_injected_total"] >= 1
+    assert snap["res_retry_total"] >= 1
+    assert "res_retry_exhausted" not in snap
+
+
 def _write_inputs(d, n_contigs=2, n_reads=6, clen=300):
     rng = np.random.default_rng(11)
     drafts, reads, paf = [], [], []
