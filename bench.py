@@ -143,6 +143,10 @@ def _ingest_bench() -> dict:
     out["ingest_speedup_vs_serial"] = round(dt_serial / dt_par, 2)
     out["ingest_seconds"] = round(dt_par, 4)
     out["ingest_bench_mb"] = round(raw_mb, 1)
+    # The parallel-inflate speedup scales with physical cores (see the
+    # docstring NOTE); publish the host's core count next to it so a
+    # ~1x on a 1-core CI box reads as by-construction, not regression.
+    out["ingest_host_cores"] = os.cpu_count() or 1
     return out
 
 
@@ -226,6 +230,58 @@ def main():
         "pipelined consensus diverged from serial"
     e2e_pipe = n_windows / dt_pipe
     pipe_extras = obs_metrics.pipeline_extras()
+
+    # Decoupled walk (ISSUE 14): a sub-workload streamed at a small
+    # chunk size so several device chunks are actually in flight, once
+    # with the walk stage decoupled (RACON_TPU_WALK_ASYNC=1 — chunk N's
+    # final-round walk dispatched as its own executable, overlapping
+    # chunk N+1's forward rounds) and once fused, consensi asserted
+    # byte-identical. Pinned to the jax backend (the decoupled path
+    # only exists there; on a native-anchored box this is the same
+    # jax-cpu backend the test suite gates on) and to RACON_TPU_SCHED=0
+    # for both runs — the scheduler keeps fused dispatches (its
+    # per-round flag pulls consume every walk), so the comparison only
+    # exists on the fixed-round path.
+    walk_bench_extras = {}
+    _walk_saved = {k: os.environ.get(k)
+                   for k in ("RACON_TPU_SCHED", "RACON_TPU_WALK_ASYNC")}
+    try:
+        os.environ["RACON_TPU_SCHED"] = "0"
+        n_walk = min(n_windows, 128)
+        walk_chunk = max(8, n_walk // 4)
+        wwindows = build_windows(n_walk, coverage, wlen, seed=7)
+        os.environ["RACON_TPU_WALK_ASYNC"] = "1"
+        obs_metrics.reset()
+        t0 = time.perf_counter()
+        with tracer.span("run", "bench_walk_async", n_windows=n_walk):
+            covered = sum(e - s for s, e in stream_consensus(
+                PoaEngine(backend="jax"), wwindows,
+                chunk=walk_chunk, depth=2))
+        dt_wasync = time.perf_counter() - t0
+        assert covered == n_walk
+        walk_ref = [w.consensus for w in wwindows]
+        walk_bench_extras = obs_metrics.walk_extras()
+        walk_bench_extras["walk_async_windows_per_sec"] = round(
+            n_walk / dt_wasync, 2)
+        os.environ["RACON_TPU_WALK_ASYNC"] = "0"
+        fwindows = build_windows(n_walk, coverage, wlen, seed=7)
+        obs_metrics.reset()
+        t0 = time.perf_counter()
+        covered = sum(e - s for s, e in stream_consensus(
+            PoaEngine(backend="jax"), fwindows,
+            chunk=walk_chunk, depth=2))
+        dt_wfused = time.perf_counter() - t0
+        assert covered == n_walk
+        assert [w.consensus for w in fwindows] == walk_ref, \
+            "decoupled-walk stream diverged from fused stream"
+        walk_bench_extras["walk_fused_windows_per_sec"] = round(
+            n_walk / dt_wfused, 2)
+    finally:
+        for k, v in _walk_saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
 
     # Compute-only: time one warm production chunk with chained reps.
     # When the convergence scheduler is on (the default), the production
@@ -334,12 +390,26 @@ def main():
                      if k.startswith("dp_")}
     ingest_bench_extras = _ingest_bench()
     extras = {**sched_extras, **e2e_transfers, **pipe_extras,
-              **probe_extras, **adaptive_extras,
+              **walk_bench_extras, **probe_extras, **adaptive_extras,
               **cache_extras(), **obs_metrics.resilience_extras(),
               **obs_metrics.ovl_extras(), **obs_metrics.dist_extras(),
               **obs_metrics.redo_extras(), **obs_metrics.ingest_extras(),
               **ingest_bench_extras, **dp_extras}
     out = {
+        # metric_version 12: same primary value as versions 2-11 (the
+        # compute bench still times the fused production chunk). New in
+        # 12: the decoupled-walk stream comparison — the workload runs
+        # through the pipeline executor twice at a small chunk size
+        # (SCHED=0, byte-identity asserted), publishing
+        # walk_async_windows_per_sec / walk_fused_windows_per_sec plus
+        # the walk_* registry extras (walk_async_enabled,
+        # walk_hidden_fraction — the fraction of walk seconds hidden
+        # behind the next chunk's forward dispatch — walk_queue_peak,
+        # walk_seconds, walk_overlap_s, walk_dispatches,
+        # walk_fused_chunks). Also new: ingest_host_cores rides along
+        # with the ingest micro-bench so the core-scaling caveat on
+        # ingest_speedup_vs_serial (≈1x on a 1-core box by construction)
+        # is readable from the record itself.
         # metric_version 11: same primary value as versions 2-10 (the
         # consensus bench itself reads no files). New in 11: the ingest
         # data-plane extras (ISSUE 12) — ingest_mb_per_sec /
@@ -422,7 +492,7 @@ def main():
         # fixed_engine_windows_per_sec. Bump this whenever the primary
         # value's definition changes, so round-over-round comparisons
         # can't silently mix metrics.
-        "metric_version": 11,
+        "metric_version": 12,
         "metric": f"POA windows/sec/chip, compute-only (direct-timed warm "
                   f"production chunk, convergence-scheduled refinement "
                   f"rounds — racon_tpu/sched/, telemetry in sched_* "

@@ -56,6 +56,9 @@ python scripts/obs_smoke.py
 echo "[ci] pipeline smoke (streamed == serial FASTA + pipe span/gauge gate)"
 python scripts/pipeline_smoke.py
 
+echo "[ci] walk overlap smoke (decoupled walk hidden>0, byte-diff vs fused, stall drill)"
+python scripts/walk_overlap_smoke.py
+
 echo "[ci] resilience smoke (injected faults + kill-and-resume byte-diff)"
 python scripts/resilience_smoke.py
 

@@ -462,13 +462,16 @@ def main(argv: Optional[List[str]] = None) -> int:
             store.close()
     out.flush()
     logger.total("[racon_tpu::Polisher::] total =")
-    from racon_tpu.obs.metrics import pipeline_extras, set_ingest_fraction
+    from racon_tpu.obs.metrics import (pipeline_extras,
+                                       set_ingest_fraction, walk_extras)
     from racon_tpu.utils.jaxcache import cache_extras
     from racon_tpu.io.ingest import ingest_enabled
     reg = obs_registry()
     for k, v in cache_extras(reg).items():
         reg.set(k, v)
     for k, v in pipeline_extras(reg).items():
+        reg.set(k, v)
+    for k, v in walk_extras(reg).items():
         reg.set(k, v)
     if int(reg.get("ingest_records", 0)):
         reg.set("ingest_enabled", int(ingest_enabled()))

@@ -534,6 +534,55 @@ def pipeline_extras(reg: Optional[MetricsRegistry] = None
     return out
 
 
+# -------------------------------------------- decoupled-walk telemetry
+
+
+def record_walk(walk_s: float, overlap_s: float, dispatches: int,
+                fused_chunks: int, queue_peak: int, enabled: bool,
+                reg: Optional[MetricsRegistry] = None) -> None:
+    """Account one stream_consensus invocation's decoupled-walk
+    telemetry (pipeline/streaming.py walk stage):
+
+    - ``walk_s``       seconds spent inside walk dispatches (the walk
+      stage's synchronized dispatch+collect window);
+    - ``overlap_s``    the portion of that during which at least one
+      OTHER chunk's forward dispatch was in flight — the latency the
+      decoupling actually hid;
+    - ``dispatches``   decoupled walk dispatches issued;
+    - ``fused_chunks`` chunks that took the fused fallback;
+    - ``queue_peak``   peak depth of the in-flight walk-input queue;
+    - ``enabled``      whether the decoupled path was active at all.
+
+    The derived ``walk_hidden_fraction`` = overlap / walk seconds is
+    the bench/ablation headline (ISSUE 14 acceptance gate)."""
+    reg = reg if reg is not None else _REGISTRY
+    reg.set("walk_async_enabled", int(bool(enabled)))
+    reg.inc("walk_seconds", float(walk_s))
+    reg.inc("walk_overlap_s", float(overlap_s))
+    reg.inc("walk_dispatches", int(dispatches))
+    reg.inc("walk_fused_chunks", int(fused_chunks))
+    reg.max("walk_queue_peak", int(queue_peak))
+    total = float(reg.get("walk_seconds", 0.0))
+    if total > 0:
+        reg.set("walk_hidden_fraction",
+                round(float(reg.get("walk_overlap_s", 0.0)) / total, 4))
+
+
+def walk_extras(reg: Optional[MetricsRegistry] = None
+                ) -> Dict[str, object]:
+    """The registry's walk_* keys as a JSON-ready dict (bench extras /
+    ablation report). Empty when no streaming run recorded walk
+    telemetry (record_walk never ran)."""
+    reg = reg if reg is not None else _REGISTRY
+    if reg.get("walk_async_enabled", None) is None:
+        return {}
+    out: Dict[str, object] = {}
+    for k, v in sorted(reg.snapshot().items()):
+        if k.startswith("walk_"):
+            out[k] = round(v, 4) if isinstance(v, float) else v
+    return out
+
+
 # ------------------------------------------------------- sched telemetry
 
 #: Canonical sched_* registry keys (docs/SCHEDULER.md documents each).
@@ -603,6 +652,10 @@ _MERGE_LAST_KEYS = frozenset({
     # Ingest plane gauges (io/ingest.py): per-run derived ratio and the
     # gate state — the ingest_* byte/second/record counters sum.
     "ingest_fraction_of_wall", "ingest_enabled",
+    # Decoupled-walk gauges (record_walk above): gate state and the
+    # derived hidden fraction — the walk_* second/dispatch counters sum
+    # and walk_queue_peak maxes via its suffix.
+    "walk_async_enabled", "walk_hidden_fraction",
 })
 
 
@@ -702,7 +755,14 @@ METRIC_SPECS = (
     ("sched_survivor_frac", MERGE_LAST, "sched_"),
     ("sched_chunks", MERGE_LAST, "sched_"),
     ("sched_windows", MERGE_LAST, "sched_"),
+    ("walk_async_enabled", MERGE_LAST, "walk_async_enabled"),
     ("walk_chain_len", MERGE_LAST, "walk_chain_len"),
+    ("walk_dispatches", MERGE_SUM, "walk_dispatches"),
+    ("walk_fused_chunks", MERGE_SUM, "walk_fused_chunks"),
+    ("walk_hidden_fraction", MERGE_LAST, "walk_hidden_fraction"),
+    ("walk_overlap_s", MERGE_SUM, "walk_overlap_s"),
+    ("walk_queue_peak", MERGE_MAX, "walk_queue_peak"),
+    ("walk_seconds", MERGE_SUM, "walk_seconds"),
 )
 
 

@@ -122,6 +122,62 @@ def walk_k_for(elems: int, env_k=None) -> int:
     return k
 
 
+# ------------------------------------- decoupled-walk in-flight queue
+
+WALK_QUEUE_ENV = "RACON_TPU_WALK_QUEUE"
+
+# Aggregate device-resident budget for QUEUED walk-input planes (the
+# dirs/nxt/nxt2 tensors a decoupled chunk parks between its forward and
+# walk dispatches — pipeline/streaming.py walk stage). Same 9/10-margin
+# discipline as the single-buffer caps above: the queue shares HBM with
+# the live forward's own planes, so it gets one buffer's worth, not the
+# whole device.
+WALK_QUEUE_BYTES = BUFFER_BYTES * 9 // 10
+
+
+def walk_plane_bytes(B: int, Lq: int, W: int, nxt_k: int) -> int:
+    """Device-resident bytes of ONE chunk's walk-input planes at lanes
+    B, query padding Lq, (band or anchor) width W and walk depth nxt_k:
+    the u8 dirs plane, plus the u8 ``nxt`` plane at k >= 2, plus the u16
+    ``nxt2`` plane at k >= 4. The per-lane scalars (lt/t_off/klo/esc0)
+    and carried round state are noise next to these and are not
+    counted."""
+    per = 1 + (1 if nxt_k >= 2 else 0) + (2 if nxt_k >= 4 else 0)
+    return int(B) * int(Lq) * int(W) * per
+
+
+def walk_queue_depth(plane_bytes: int, want: int) -> int:
+    """Admissible in-flight walk-queue depth: the requested depth
+    ``want``, clamped so ``depth * plane_bytes <= WALK_QUEUE_BYTES``.
+    0 means the decoupled path is off (the streaming executor falls
+    back to fused dispatches); a geometry too large for even one queued
+    chunk clamps to 0 rather than admitting an over-budget plane."""
+    if want <= 0:
+        return 0
+    if plane_bytes <= 0:
+        return int(want)
+    return min(int(want), WALK_QUEUE_BYTES // int(plane_bytes))
+
+
+def walk_queue_env(default: int) -> int:
+    """The requested walk-queue depth from ``RACON_TPU_WALK_QUEUE``
+    (empty -> ``default``, usually the pipeline depth). Non-integers
+    and negatives are hard errors — same typo discipline as
+    walk_k_env."""
+    raw = envspec.read(WALK_QUEUE_ENV).strip()
+    if not raw:
+        return int(default)
+    try:
+        d = int(raw)
+    except ValueError:
+        d = -1
+    if d < 0:
+        raise ValueError(
+            f"[racon_tpu::budget] {WALK_QUEUE_ENV}={raw!r} invalid — "
+            "expected a non-negative integer queue depth")
+    return d
+
+
 # ---------------------------------------------------------------------------
 # Per-tile admission tiers for the TILED band forward (ultralong reads).
 #

@@ -41,6 +41,8 @@ stores LEFT — exactly the i==0 forced-LEFT walk of the legacy traceback
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -289,3 +291,76 @@ def col_walk(cells, lq, lt, klo, t_off, *, LA: int, layout: str,
     ch = jnp.transpose(ys.reshape(-1, B, 4), (1, 0, 2))[:, :LA + 2]
     return {"ins_len": ch[..., 0], "qstart": ch[..., 1],
             "op_c": ch[..., 2], "qi_c": ch[..., 3], "sat": sat}
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("ins_scale", "Lq", "n_win", "LA", "pallas",
+                     "band_w", "rounds"))
+def walk_chunk_packed(job_buf, dirs, nxt, nxt2, lt, t_off, klo, esc0,
+                      bb, bbw, alen, begin, end, ovf, rexec0, *,
+                      ins_scale, Lq, n_win, LA, pallas, band_w, rounds):
+    """The standalone walk half of a chunk: consume device_chunk_fwd's
+    plane/state tuple and finish the chunk's FINAL round — column walk,
+    vote merge, consensus assembly — producing the exact packed output
+    buffer device_chunk_packed would have (collect_chunk unpacks both).
+
+    Bit-identity to the fused program is by construction, not by
+    tolerance: this composes the SAME traced bodies (_lane_walk,
+    _merge_round, _pack_body from ops/device_poa.py) the fused program
+    inlines, on the planes the shared _lane_fwd produced; the round
+    state (bb/bbw/alen/begin/end/ovf) crosses the program boundary as
+    live device arrays, never leaving the device. ``match/mismatch/gap``
+    are absent on purpose — the forward already folded the scoring
+    bound into ``esc0``.
+
+    Compiled per shape bucket like every chunk executable; ``ins_scale``
+    here is the FINAL round's scale (a scalar static, not the tuple).
+    """
+    # Lazy import: device_poa imports this module's col_walk/chain_len
+    # inside functions only, so the cycle never materializes at import.
+    from racon_tpu.ops.device_poa import (_lane_walk, _merge_round,
+                                          _pack_body, _unpack_job)
+
+    # Round-invariant job fields come back out of the SAME byte layout
+    # the forward dispatch shipped; the packed begin/end are the round-0
+    # spans and are superseded by the carried state's begin/end.
+    q, qw8, _b0, _e0, lq, win, w_read = _unpack_job(job_buf, Lq)
+    votes, esc_w = _lane_walk(dirs, nxt, nxt2, lt, t_off, klo, esc0,
+                              q, qw8, lq, w_read, LA=LA, pallas=pallas,
+                              band_w=band_w)
+    new_bb, _bbw, new_alen, _nb, _ne, cov, ovf, _conv = _merge_round(
+        votes, esc_w, bb, bbw, alen, begin, end, win, ovf,
+        ins_scale=ins_scale, n_win=n_win, LA=LA, detect=False,
+        axis_name=None)
+    return _pack_body(new_bb[:-1], cov, new_alen[:-1], ovf,
+                      rexec0 + 1, jnp.int32(rounds))
+
+
+def dispatch_walk(plan, fwd_out, meta):
+    """Ship the decoupled walk for a chunk whose forward half was
+    dispatched by ops/device_poa.py::dispatch_chunk_fwd. Returns the
+    packed output buffer (still in flight) for collect_chunk.
+
+    Its own fault/retry envelope: site ``dispatch/walk`` with a
+    geometry deadline over ONE round's cells at the final band width —
+    the walk's serialized gather chain is bounded by that plane, not by
+    the whole chunk's round budget.
+    """
+    from racon_tpu.obs.metrics import registry as obs_registry
+    from racon_tpu.ops.budget import dispatch_deadline_s
+    from racon_tpu.ops.device_poa import round_band_width
+    from racon_tpu.resilience.retry import call as retry_call
+
+    band_w = meta["band_w"]
+    rounds = meta["rounds"]
+    W = round_band_width(band_w, rounds - 1) if band_w else plan.LA
+    sc = meta["ins_scale"]
+    scales = sc if isinstance(sc, tuple) else (sc,) * rounds
+    packed = retry_call(
+        "dispatch/walk", walk_chunk_packed, meta["job_buf"], *fwd_out,
+        ins_scale=scales[-1], Lq=plan.Lq, n_win=plan.n_win, LA=plan.LA,
+        pallas=meta["pallas"], band_w=band_w, rounds=rounds,
+        deadline_s=dispatch_deadline_s(plan.B * plan.Lq * W))
+    obs_registry().inc("device_dispatches")
+    return packed

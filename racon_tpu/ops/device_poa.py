@@ -366,31 +366,28 @@ def _packed_byte_slice(tab, start, L: int):
     return out
 
 
-def _lane_votes(bb, alen, begin, end, q, qw8, lq, w_read, win, *,
-                match, mismatch, gap, Lq, LA, pallas, band_w=0,
-                nxt_k=2):
-    """Job geometry + NW forward + column-walk + vote extraction for
-    every lane of one refinement round (traced body, one shard's view).
+def _lane_fwd(bb, alen, begin, end, q, lq, win, *,
+              match, mismatch, gap, Lq, LA, pallas, band_w=0,
+              nxt_k=2):
+    """Job geometry + NW forward for every lane of one refinement round
+    (traced body, one shard's view): the half of _lane_votes that ends
+    at the packed direction planes, before any serialized traceback.
 
-    The shared front half of a round: the fixed-round engine
-    (_round_core) and the convergence scheduler's detecting round
-    (racon_tpu/sched/rounds.py) both consume its output, so the two
-    dispatch paths run one implementation of the alignment contract.
+    Returns ``(dirs, nxt, nxt2, lt, t_off, klo, esc0)``: the forward's
+    packed cell plane plus the k-step predecessor planes (``nxt`` /
+    ``nxt2`` are None below their depth; see docs/KERNELS.md), the
+    per-lane geometry vectors the walk re-uses verbatim (``klo`` is
+    None on the flat path), and ``esc0`` — the band-escape certificate
+    term, f32[B], already resolved from ``hlast`` here so the decoupled
+    walk dispatch never needs the score plane (None on the flat path,
+    whose only inexactness signal is walk saturation).
 
-    ``nxt_k`` (static; 2 or 4) selects the banded walk's predecessor
-    depth — at 4 the forward also emits the u16 ``nxt2`` hop plane and
-    the column walk undoes four anchor positions per dependent gather
-    (budget.walk_k_for picks it per geometry; the flat path has no nxt
-    plane and ignores it).
-
-    Returns (votes dict of per-job channels for dm.aggregate_votes,
-    esc_w f32[B] — positive where the banded walk's exactness
-    certificate failed and the lane's window must re-polish on the
-    redo path).
+    The fused round (_lane_votes) and the decoupled walk dispatch
+    (ops/colwalk.py walk_chunk_packed) both build on this body, so the
+    split is bit-identical by construction.
     """
     import jax
     import jax.numpy as jnp
-    from racon_tpu.ops import device_merge as dm
 
     B = q.shape[0]
     L = jnp.take(alen, win)                             # anchor len per job
@@ -407,8 +404,6 @@ def _lane_votes(bb, alen, begin, end, q, qw8, lq, w_read, win, *,
     lt = jnp.where(full, L, e_c - b_c + 1).astype(jnp.int32)
 
     flat = bb.reshape(-1)
-    from racon_tpu.ops.colwalk import col_walk
-    esc_w = None
     if band_w:
         # Diagonal band (racon_tpu/ops/pallas/band_kernel.py): per-lane
         # geometry pre-baked into a shifted target buffer; exactness per
@@ -447,9 +442,6 @@ def _lane_votes(bb, alen, begin, end, q, qw8, lq, w_read, win, *,
             nxt2 = None
             if nxt_k < 2:           # single-step reference walk
                 nxt = None
-        cols = col_walk(dirs, lq, lt, klo, t_off, LA=LA,
-                        layout="band_t" if pallas else "band", nxt=nxt,
-                        nxt2=nxt2)
         # Escape bound (see nw.cpp): banded score must beat any path
         # that leaves the band, else the lane's window is re-polished on
         # the unbounded host path. Any out-of-band path carries at least
@@ -464,7 +456,8 @@ def _lane_votes(bb, alen, begin, end, q, qw8, lq, w_read, win, *,
         score = jnp.take_along_axis(hlast, xend[:, None], axis=1)[:, 0]
         bound = (jnp.maximum(match, 0) * (jnp.minimum(lq, lt) - wl - 1) +
                  gap * (jnp.abs(lt - lq) + 2 * wl + 2))
-        esc_w = ((score < bound) | (wl < 16)).astype(jnp.float32)
+        esc0 = ((score < bound) | (wl < 16)).astype(jnp.float32)
+        return dirs, nxt, nxt2, lt, t_off, klo, esc0
     else:
         # Full-width absolute coordinates: tbuf[b, x] = anchor slice
         # (same batched dynamic_slice trick as the banded path).
@@ -483,14 +476,69 @@ def _lane_votes(bb, alen, begin, end, q, qw8, lq, w_read, win, *,
             dirs = flatmod.fw_dirs_xla(tbuf, q.T,
                                        match=match, mismatch=mismatch,
                                        gap=gap)
-        cols = col_walk(dirs, lq, lt, None, t_off, LA=LA, layout="flat")
+        return dirs, None, None, lt, t_off, None, None
 
+
+def _lane_walk(dirs, nxt, nxt2, lt, t_off, klo, esc0, q, qw8, lq,
+               w_read, *, LA, pallas, band_w=0):
+    """Column-walk traceback + vote extraction over _lane_fwd's planes
+    (traced body, one shard's view) — the serialized-gather half of a
+    round, the part the decoupled walk dispatch takes off the critical
+    path.
+
+    Returns (votes dict for dm.aggregate_votes, esc_w f32[B]) exactly
+    as _lane_votes always has: ``esc0`` (the forward's escape term)
+    plus walk saturation.
+    """
+    import jax.numpy as jnp
+    from racon_tpu.ops import device_merge as dm
+    from racon_tpu.ops.colwalk import col_walk
+
+    if band_w:
+        cols = col_walk(dirs, lq, lt, klo, t_off, LA=LA,
+                        layout="band_t" if pallas else "band", nxt=nxt,
+                        nxt2=nxt2)
+    else:
+        cols = col_walk(dirs, lq, lt, None, t_off, LA=LA, layout="flat")
     votes = dm.extract_votes_cols(cols, q, qw8, w_read, lt, t_off, LA)
     # Saturated up-run counters make the walk inexact for that lane —
     # same redo route as the band escape bound.
     sat_w = cols["sat"].astype(jnp.float32)
-    esc_w = sat_w if esc_w is None else esc_w + sat_w
+    esc_w = sat_w if esc0 is None else esc0 + sat_w
     return votes, esc_w
+
+
+def _lane_votes(bb, alen, begin, end, q, qw8, lq, w_read, win, *,
+                match, mismatch, gap, Lq, LA, pallas, band_w=0,
+                nxt_k=2):
+    """Job geometry + NW forward + column-walk + vote extraction for
+    every lane of one refinement round (traced body, one shard's view).
+
+    The shared front half of a round: the fixed-round engine
+    (_round_core) and the convergence scheduler's detecting round
+    (racon_tpu/sched/rounds.py) both consume its output, so the two
+    dispatch paths run one implementation of the alignment contract.
+    Internally it is _lane_fwd (geometry + forward planes) composed
+    with _lane_walk (traceback + votes) — the decoupled walk dispatch
+    runs the same two bodies split across two executables, which is
+    what makes it bit-identical to this fused form.
+
+    ``nxt_k`` (static; 2 or 4) selects the banded walk's predecessor
+    depth — at 4 the forward also emits the u16 ``nxt2`` hop plane and
+    the column walk undoes four anchor positions per dependent gather
+    (budget.walk_k_for picks it per geometry; the flat path has no nxt
+    plane and ignores it).
+
+    Returns (votes dict of per-job channels for dm.aggregate_votes,
+    esc_w f32[B] — positive where the banded walk's exactness
+    certificate failed and the lane's window must re-polish on the
+    redo path).
+    """
+    dirs, nxt, nxt2, lt, t_off, klo, esc0 = _lane_fwd(
+        bb, alen, begin, end, q, lq, win, match=match, mismatch=mismatch,
+        gap=gap, Lq=Lq, LA=LA, pallas=pallas, band_w=band_w, nxt_k=nxt_k)
+    return _lane_walk(dirs, nxt, nxt2, lt, t_off, klo, esc0, q, qw8, lq,
+                      w_read, LA=LA, pallas=pallas, band_w=band_w)
 
 
 def _remap_state(codes, total, map_b, map_e, bb, alen, begin, end, win,
@@ -541,14 +589,26 @@ def _round_core(bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf, *,
     shard) — windows are otherwise independent, matching the reference's
     per-window fan-out (src/polisher.cpp:457-469).
     """
-    import jax
-    import jax.numpy as jnp
-    from racon_tpu.ops import device_merge as dm
-
     votes, esc_w = _lane_votes(
         bb, alen, begin, end, q, qw8, lq, w_read, win, match=match,
         mismatch=mismatch, gap=gap, Lq=Lq, LA=LA, pallas=pallas,
         band_w=band_w, nxt_k=nxt_k)
+    return _merge_round(votes, esc_w, bb, bbw, alen, begin, end, win,
+                        ovf, ins_scale=ins_scale, n_win=n_win, LA=LA,
+                        detect=detect, axis_name=axis_name)
+
+
+def _merge_round(votes, esc_w, bb, bbw, alen, begin, end, win, ovf, *,
+                 ins_scale, n_win, LA, detect=False, axis_name=None):
+    """Vote aggregation through state remap — the back half of a round
+    (traced body). Shared verbatim by the fused round (_round_core
+    above) and the decoupled walk dispatch (ops/colwalk.py
+    walk_chunk_packed), so the two paths assemble consensus through one
+    implementation; see _round_core for the output contract."""
+    import jax
+    import jax.numpy as jnp
+    from racon_tpu.ops import device_merge as dm
+
     # The band-escape per-window sum rides aggregate_votes' membership
     # matrix and the same single psum as the votes.
     acc = dm.aggregate_votes(votes, win, n_win + 1, extras={"_esc": esc_w})
@@ -639,13 +699,13 @@ def _make_round_fn(*, match, mismatch, gap, ins_scale, Lq, n_win, LA,
         check_vma=False)
 
 
-def _unpack_bufs(job_buf, win_buf, Lq: int, LA: int):
-    """Slice ChunkPlan.packed_bufs()' concatenated byte layouts back into
-    round-state arrays (traced body). The layout contract lives here and
-    in packed_bufs, nowhere else.
-
-    Returns (q, qw8, begin, end, lq, win, w_read, bb, bbw, alen).
-    """
+def _unpack_job(job_buf, Lq: int):
+    """Slice ChunkPlan.packed_bufs()' job byte layout back into per-lane
+    arrays (traced body): ``(q, qw8, begin, end, lq, win, w_read)``.
+    Split out of _unpack_bufs so the decoupled walk dispatch (which
+    carries its round state as live device arrays, not the win buffer)
+    can recover the round-invariant job fields from the same layout
+    contract."""
     import jax
     import jax.numpy as jnp
 
@@ -662,6 +722,23 @@ def _unpack_bufs(job_buf, win_buf, Lq: int, LA: int):
     win = i32(sc[:, 12:16].reshape(B, 1, 4))[:, 0]
     w_read = jax.lax.bitcast_convert_type(
         sc[:, 16:20].reshape(B, 1, 4), jnp.float32)[:, 0]
+    return q, qw8, begin, end, lq, win, w_read
+
+
+def _unpack_bufs(job_buf, win_buf, Lq: int, LA: int):
+    """Slice ChunkPlan.packed_bufs()' concatenated byte layouts back into
+    round-state arrays (traced body). The layout contract lives here and
+    in packed_bufs, nowhere else.
+
+    Returns (q, qw8, begin, end, lq, win, w_read, bb, bbw, alen).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def i32(col):
+        return jax.lax.bitcast_convert_type(col, jnp.int32)
+
+    q, qw8, begin, end, lq, win, w_read = _unpack_job(job_buf, Lq)
     Nw1 = win_buf.shape[0]
     bb = win_buf[:, :LA]
     bbw = jax.lax.bitcast_convert_type(
@@ -704,11 +781,46 @@ def device_chunk_packed(job_buf, win_buf, *, match, mismatch, gap,
     3 rounds instead of ``rounds``. Requires rounds >= 3 and uniform
     non-final scales; the caller checks both.
     """
-    import jax
     import jax.numpy as jnp
 
     (q, qw8, begin, end, lq, win, w_read, bb, bbw, alen) = \
         _unpack_bufs(job_buf, win_buf, Lq, LA)
+    state, cov, rexec0 = _rounds_before_final(
+        bb, bbw, alen, begin, end, q, qw8, lq, w_read, win,
+        match=match, mismatch=mismatch, gap=gap, ins_scale=ins_scale,
+        Lq=Lq, n_win=n_win, LA=LA, pallas=pallas, band_w=band_w,
+        rounds=rounds, adaptive=adaptive, mesh=mesh, nxt_k=nxt_k)
+    bb, bbw, alen, begin, end, ovf = state
+    scales = ins_scale if isinstance(ins_scale, tuple) \
+        else (ins_scale,) * rounds
+    # Final round always runs (final-scale assembly).
+    final = _make_round_fn(
+        match=match, mismatch=mismatch, gap=gap, ins_scale=scales[-1],
+        Lq=Lq, n_win=n_win, LA=LA, pallas=pallas,
+        band_w=round_band_width(band_w, rounds - 1), mesh=mesh,
+        nxt_k=nxt_k, detect=False)
+    bb, bbw, alen, begin, end, cov, ovf, conv = final(
+        bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf)
+    return _pack_body(bb[:-1], cov, alen[:-1], ovf, rexec0 + 1,
+                      jnp.int32(rounds))
+
+
+def _rounds_before_final(bb, bbw, alen, begin, end, q, qw8, lq, w_read,
+                         win, *, match, mismatch, gap, ins_scale, Lq,
+                         n_win, LA, pallas, band_w, rounds, adaptive,
+                         mesh, nxt_k):
+    """Refinement rounds 0 .. rounds-2 of a chunk (traced body): the
+    shared prefix of the fused program (device_chunk_packed) and the
+    forward-only program (device_chunk_fwd), factored out so the
+    decoupled walk path replays the exact round chain the fused path
+    compiles — same calls, same order, same jaxpr prefix.
+
+    Returns ``((bb, bbw, alen, begin, end, ovf), cov, rexec0)`` where
+    ``rexec0`` (traced int32) counts the rounds executed so far — the
+    caller's final round adds one.
+    """
+    import jax
+    import jax.numpy as jnp
 
     ovf = jnp.zeros(n_win, dtype=bool)
     conv = jnp.zeros(n_win, dtype=bool)
@@ -724,13 +836,13 @@ def device_chunk_packed(job_buf, win_buf, *, match, mismatch, gap,
             mesh=mesh, nxt_k=nxt_k, detect=det)
 
     if not adaptive:
-        for r in range(rounds):
+        for r in range(rounds - 1):
             bw = round_band_width(band_w, r)
             bb, bbw, alen, begin, end, cov, ovf, conv = \
                 make_round(bw, scales[r], False)(
                     bb, bbw, alen, begin, end, q, qw8, lq, w_read, win,
                     ovf)
-        rexec = jnp.int32(rounds)
+        rexec0 = jnp.int32(rounds - 1)
     else:
         # Round 0 (full band): detection cannot fire — its input anchor
         # carries backbone quality weights and is not a replayable state
@@ -757,14 +869,55 @@ def device_chunk_packed(job_buf, win_buf, *, match, mismatch, gap,
         (k, bb, bbw, alen, begin, end, cov, ovf, conv) = \
             jax.lax.while_loop(cond, body, (jnp.int32(1), bb, bbw, alen,
                                             begin, end, cov, ovf, conv))
-        # Final round always runs (final-scale assembly).
-        bb, bbw, alen, begin, end, cov, ovf, conv = \
-            make_round(round_band_width(band_w, rounds - 1), scales[-1],
-                       False)(
-                bb, bbw, alen, begin, end, q, qw8, lq, w_read, win, ovf)
-        rexec = k + 1
-    return _pack_body(bb[:-1], cov, alen[:-1], ovf, rexec,
-                      jnp.int32(rounds))
+        rexec0 = k
+    return (bb, bbw, alen, begin, end, ovf), cov, rexec0
+
+
+@functools.partial(
+    __import__("jax").jit,
+    static_argnames=("match", "mismatch", "gap", "ins_scale", "Lq",
+                     "n_win", "LA", "pallas", "band_w", "rounds",
+                     "adaptive", "nxt_k"))
+def device_chunk_fwd(job_buf, win_buf, *, match, mismatch, gap,
+                     ins_scale, Lq, n_win, LA, pallas, band_w, rounds,
+                     adaptive=False, nxt_k=2):
+    """The forward/refinement half of a chunk in one jit dispatch: all
+    non-final rounds fully fused (identical chain to
+    device_chunk_packed, including the adaptive while_loop), then the
+    FINAL round's geometry + NW forward only — its serialized traceback
+    walk is NOT run here.
+
+    Returns the packed direction planes plus everything the standalone
+    walk dispatch (ops/colwalk.py walk_chunk_packed) needs to finish the
+    chunk byte-identically: ``(dirs, nxt, nxt2, lt, t_off, klo, esc0,
+    bb, bbw, alen, begin, end, ovf, rexec0)`` — the plane tuple from
+    _lane_fwd at the final round's band width, the carried round state
+    ENTERING the final round, and the executed-round count so far
+    (None leaves where depth/layout elides a plane; jit treats them as
+    empty pytree nodes). Every refinement round before the final one
+    already consumed its own walk inside this program — only the last
+    walk has no dependent anchor state, which is exactly why it alone
+    can leave the critical path (pipeline/streaming.py walk stage).
+
+    Single-device only: the decoupled path is gated off under a dp mesh
+    (the walk-side vote psum would need the mesh threaded through a
+    second executable for no overlap win — the per-shard walk still
+    serializes on the same chips).
+    """
+    (q, qw8, begin, end, lq, win, w_read, bb, bbw, alen) = \
+        _unpack_bufs(job_buf, win_buf, Lq, LA)
+    state, _cov, rexec0 = _rounds_before_final(
+        bb, bbw, alen, begin, end, q, qw8, lq, w_read, win,
+        match=match, mismatch=mismatch, gap=gap, ins_scale=ins_scale,
+        Lq=Lq, n_win=n_win, LA=LA, pallas=pallas, band_w=band_w,
+        rounds=rounds, adaptive=adaptive, mesh=None, nxt_k=nxt_k)
+    bb, bbw, alen, begin, end, ovf = state
+    dirs, nxt, nxt2, lt, t_off, klo, esc0 = _lane_fwd(
+        bb, alen, begin, end, q, lq, win, match=match, mismatch=mismatch,
+        gap=gap, Lq=Lq, LA=LA, pallas=pallas,
+        band_w=round_band_width(band_w, rounds - 1), nxt_k=nxt_k)
+    return (dirs, nxt, nxt2, lt, t_off, klo, esc0,
+            bb, bbw, alen, begin, end, ovf, rexec0)
 
 
 @functools.partial(
@@ -1009,6 +1162,83 @@ def dispatch_chunk(plan: ChunkPlan, *, match: int, mismatch: int,
 
     return _pack_out(bb[:-1], cov, alen[:-1], ovf,
                      jnp.int32(rounds), jnp.int32(rounds))
+
+
+def chunk_statics(plan: ChunkPlan, *, ins_scale, rounds: int) -> dict:
+    """The per-chunk static selections dispatch_chunk makes (pallas /
+    band width / walk depth / adaptive gate), as one dict — the
+    decoupled path computes them ONCE here and threads the same values
+    through both its executables, so the fwd and walk programs can
+    never disagree about layout or depth. Single-device form (ndp=1):
+    the decoupled walk is gated off under a mesh."""
+    pallas = _use_pallas(plan.B, plan.Lq, plan.LA)
+    band_w = (0 if envspec.read("RACON_TPU_NO_BAND")
+              not in ("", "0", "false") else plan.band_w)
+    from racon_tpu.ops.budget import walk_k_for
+    nxt_k = walk_k_for(plan.B * plan.Lq * band_w) if band_w else 1
+    sc = ins_scale if isinstance(ins_scale, tuple) \
+        else (ins_scale,) * rounds
+    adaptive = (envspec.read("RACON_TPU_ADAPTIVE")
+                not in ("0", "false")
+                and rounds >= 3 and len(set(sc[:-1])) <= 1)
+    return {"pallas": pallas, "band_w": band_w, "nxt_k": nxt_k,
+            "adaptive": adaptive}
+
+
+def walk_plane_bytes_for(plan: ChunkPlan, *, ins_scale, rounds: int,
+                         statics: Optional[dict] = None) -> int:
+    """Device-resident bytes of the walk-input planes one queued chunk
+    holds across the decoupled handoff — budget.walk_plane_bytes at the
+    FINAL round's band width (the only round whose planes outlive their
+    dispatch). The streaming executor's admission check compares this
+    against budget.walk_queue_depth's aggregate cap."""
+    from racon_tpu.ops.budget import walk_plane_bytes
+    st = statics if statics is not None else \
+        chunk_statics(plan, ins_scale=ins_scale, rounds=rounds)
+    band_w = st["band_w"]
+    W = round_band_width(band_w, rounds - 1) if band_w else plan.LA
+    return walk_plane_bytes(plan.B, plan.Lq, W,
+                            st["nxt_k"] if band_w else 1)
+
+
+def dispatch_chunk_fwd(plan: ChunkPlan, *, match: int, mismatch: int,
+                       gap: int, ins_scale, rounds: int,
+                       bufs: Optional[Tuple[object, object]] = None):
+    """Ship a chunk's forward/refinement half (device_chunk_fwd) —
+    returns ``(fwd_out, meta)`` where ``fwd_out`` is the still-in-flight
+    plane/state tuple and ``meta`` the static selections plus the live
+    ``job_buf`` that ops/colwalk.py::dispatch_walk needs to finish the
+    chunk. Same "dispatch/chunk" retry site and geometry deadline as
+    the fused dispatch (it IS the chunk's forward dispatch); the walk
+    dispatch adds its own "dispatch/walk" envelope.
+
+    Single-device only (no ``mesh``): the streaming executor falls back
+    to the fused path under dp — see device_chunk_fwd's docstring.
+    """
+    from racon_tpu.obs.metrics import registry as obs_registry
+    from racon_tpu.ops.budget import dispatch_deadline_s
+    from racon_tpu.ops.colwalk import chain_len
+    from racon_tpu.resilience.retry import call as retry_call
+
+    st = chunk_statics(plan, ins_scale=ins_scale, rounds=rounds)
+    band_w = st["band_w"]
+    obs_registry().set("walk_chain_len",
+                       chain_len(plan.LA, st["nxt_k"] if band_w else 1))
+    if bufs is None:
+        bufs = put_chunk_bufs(plan)
+    job_buf, win_buf = bufs
+    cells = (plan.B * plan.Lq * (band_w if band_w else plan.LA)
+             * max(rounds, 1))
+    fwd_out = retry_call(
+        "dispatch/chunk", device_chunk_fwd, job_buf, win_buf,
+        match=match, mismatch=mismatch, gap=gap, ins_scale=ins_scale,
+        Lq=plan.Lq, n_win=plan.n_win, LA=plan.LA,
+        pallas=st["pallas"], band_w=band_w, rounds=rounds,
+        adaptive=st["adaptive"], nxt_k=st["nxt_k"],
+        deadline_s=dispatch_deadline_s(cells))
+    obs_registry().inc("device_dispatches")
+    meta = dict(st, job_buf=job_buf, ins_scale=ins_scale, rounds=rounds)
+    return fwd_out, meta
 
 
 def collect_chunk(plan: ChunkPlan, packed, stats: Optional[dict] = None

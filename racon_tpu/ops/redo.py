@@ -33,6 +33,12 @@ device == host contract (the redo runs the same program, just wider).
 ``RACON_TPU_REDO=0`` disables the device pass (PR 5/7 behavior: every
 flagged window host-repolishes). Counters: obs record_redo publishes
 ``redo_device_windows`` / ``redo_host_windows`` / ``redo_passes``.
+
+Redo dispatches stay FUSED forward+walk even when the streaming
+executor runs the decoupled-walk stage (ops/colwalk.py): a redo is
+rare tail work serialized behind the chunk it repairs — there is no
+following forward dispatch to hide its walk behind, so decoupling it
+would add a dispatch boundary for zero overlap.
 """
 
 from __future__ import annotations

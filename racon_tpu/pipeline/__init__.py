@@ -36,6 +36,7 @@ from typing import Optional
 
 ENV_PIPELINE = "RACON_TPU_PIPELINE"
 ENV_DEPTH = "RACON_TPU_PIPELINE_DEPTH"
+ENV_WALK_ASYNC = "RACON_TPU_WALK_ASYNC"
 
 #: Default bound on in-flight chunks per queue: depth 2 = classic double
 #: buffering (chunk N computes while chunk N+1's buffers sit in HBM).
@@ -70,6 +71,19 @@ def pipeline_enabled() -> bool:
     return env not in ("",)
 
 
+def walk_async_enabled() -> bool:
+    """Decoupled-walk gate (default ON): when the streaming pipeline
+    runs on the fixed-round single-device jax path, each chunk's FINAL
+    traceback walk dispatches as its own executable in a dedicated walk
+    stage, overlapping the next chunk's forward rounds.
+    ``RACON_TPU_WALK_ASYNC=0`` forces the fused forward+walk dispatch
+    everywhere; the executor also falls back automatically where
+    overlap is impossible (pipeline off, scheduler path, dp mesh, last
+    chunk, over-budget queue — docs/KERNELS.md lists the conditions).
+    Both paths are bit-identical (tests/test_walk_async.py)."""
+    return envspec.read(ENV_WALK_ASYNC) not in ("0", "false")
+
+
 def pipeline_depth() -> int:
     """Bounded-queue capacity (in-flight chunks per stage edge)."""
     if _cli_depth is not None and _cli_depth > 0:
@@ -95,7 +109,8 @@ from racon_tpu.pipeline.stages import (ENV_STALL, Pipeline,  # noqa: E402
 
 __all__ = [
     "BoundedQueue", "DEFAULT_DEPTH", "ENV_DEPTH", "ENV_PIPELINE",
-    "ENV_STALL", "Pipeline", "PipelineAborted", "PipelineStalled",
-    "QueueClosed", "StageError", "configure", "pipeline_depth",
-    "pipeline_enabled", "stall_window_s",
+    "ENV_STALL", "ENV_WALK_ASYNC", "Pipeline", "PipelineAborted",
+    "PipelineStalled", "QueueClosed", "StageError", "configure",
+    "pipeline_depth", "pipeline_enabled", "stall_window_s",
+    "walk_async_enabled",
 ]

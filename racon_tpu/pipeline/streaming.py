@@ -6,9 +6,9 @@ two paths share the planning code, so chunk composition and therefore
 output are identical by construction), but spread over four overlapped
 stages:
 
-    build ──q──▶ pack ──q──▶ h2d ──q──▶ compute ──q──▶ (caller drains)
-                   │                                ▲
-                   └── host-path items ─────────────┘
+    build ──q──▶ pack ──q──▶ h2d ──q──▶ compute ──q──▶ walk ──q──▶ (drain)
+                   │                                            ▲
+                   └── host-path items ─────────────────────────┘
 
 - **build** (producer): slice the window list by ``chunk``, polish
   trivial windows (backbone consensus) inline, partition the rest into
@@ -26,7 +26,18 @@ stages:
 - **compute** runs the rounds (ConvergenceScheduler.run_chunk when
   sched is on, dispatch_chunk/collect_chunk otherwise), decodes the d2h
   pull, applies consensus to the windows, and re-polishes truncated
-  windows on the host path.
+  windows on the host path. On the decoupled-walk path (fixed rounds,
+  single device, RACON_TPU_WALK_ASYNC on) it instead dispatches only
+  the forward/refinement half (dispatch_chunk_fwd) and forwards the
+  in-flight plane tuple downstream.
+- **walk** finishes decoupled chunks — the standalone final-round walk
+  dispatch (ops/colwalk.py::dispatch_walk), d2h decode, consensus
+  apply — so chunk N's serialized traceback overlaps chunk N+1's
+  forward dispatch in the compute stage. Its queue of in-flight walk
+  inputs is budget-bounded (ops/budget.py walk_queue_depth) so parked
+  planes never breach the device buffer caps; fused items pass through
+  untouched. Fallbacks to the fused path: gate off, sched path, dp
+  mesh, last chunk, over-budget geometry, and degraded items.
 
 The caller drains completed items; :class:`SliceTracker` releases
 contiguous leading slices in input order, so downstream FASTA emission
@@ -159,7 +170,8 @@ def serial_chunks(parser, max_bytes: int) -> Iterator[Tuple[List, bool]]:
 
 class _Item:
     """One unit of pipeline work: a device chunk group or a host batch."""
-    __slots__ = ("kind", "sid", "gid", "windows", "sp", "plan", "bufs")
+    __slots__ = ("kind", "sid", "gid", "windows", "sp", "plan", "bufs",
+                 "fwd", "last")
 
     def __init__(self, kind: str, sid: int, windows, sp=None, gid: int = 0):
         self.kind = kind        # "chunk" | "host"
@@ -169,6 +181,67 @@ class _Item:
         self.sp = sp            # _DeviceSlicePlan (chunk items)
         self.plan = None        # ChunkPlan, set by the pack stage
         self.bufs = None        # device buffers, set by the h2d stage
+        self.fwd = None         # (fwd_out, meta) from a decoupled
+        #                         forward dispatch (compute stage); None
+        #                         means the item took the fused path.
+        self.last = False       # final chunk item of the stream — no
+        #                         following forward to overlap with, so
+        #                         it always dispatches fused.
+
+
+class _WalkOverlapMeter:
+    """Accounts how much decoupled-walk time was actually HIDDEN.
+
+    A chunk's forward is "in flight" from its fwd dispatch until its own
+    walk begins; while a walk runs, every second during which at least
+    one OTHER chunk's forward is in flight is overlap — latency the
+    fused path would have paid serially. The walk stage is single-
+    threaded, so no forward leaves the in-flight set during a walk
+    window; the set only grows (new fwd dispatches), which makes the
+    overlap window exactly [first moment others exist, walk end].
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._inflight: set = set()
+        self._cur_key = None
+        self._cur_start: Optional[float] = None
+        self._cur_overlap_from: Optional[float] = None
+        self.walk_s = 0.0
+        self.overlap_s = 0.0
+        self.dispatches = 0
+        self.fused = 0
+
+    def fwd_dispatched(self, key) -> None:
+        with self._lock:
+            self._inflight.add(key)
+            if (self._cur_start is not None
+                    and self._cur_overlap_from is None
+                    and self._inflight - {self._cur_key}):
+                self._cur_overlap_from = time.perf_counter()
+
+    def note_fused(self) -> None:
+        with self._lock:
+            self.fused += 1
+
+    def walk_begin(self, key) -> None:
+        with self._lock:
+            self._inflight.discard(key)
+            self._cur_key = key
+            self._cur_start = time.perf_counter()
+            self._cur_overlap_from = \
+                self._cur_start if self._inflight else None
+
+    def walk_end(self, key) -> None:
+        with self._lock:
+            now = time.perf_counter()
+            if self._cur_start is not None:
+                self.walk_s += now - self._cur_start
+                if self._cur_overlap_from is not None:
+                    self.overlap_s += now - self._cur_overlap_from
+            self._cur_key = None
+            self._cur_start = self._cur_overlap_from = None
+            self.dispatches += 1
 
 
 class SliceTracker:
@@ -251,9 +324,10 @@ def stream_consensus(engine, windows, chunk: int = 8192,
     depth = max(1, int(depth))
     chunk = max(1, int(chunk))
 
-    from racon_tpu.obs.metrics import (record_pipeline_wall,
+    from racon_tpu.obs.metrics import (record_pipeline_wall, record_walk,
                                        record_windows)
     from racon_tpu.obs.trace import get_tracer
+    from racon_tpu.pipeline import walk_async_enabled
     from racon_tpu.sched import sched_enabled
     tracer = get_tracer()
 
@@ -265,12 +339,34 @@ def stream_consensus(engine, windows, chunk: int = 8192,
     sched = engine._make_scheduler() \
         if backend_is_jax and sched_enabled() else None
 
+    # Decoupled-walk gate: fixed-round single-device jax path only. The
+    # scheduler consumes every round's walk on the host (per-round flag
+    # pulls), and under a dp mesh the walk-side psum would need the mesh
+    # threaded through a second executable for no overlap win — both
+    # keep the fused dispatch. want_q = 0 (RACON_TPU_WALK_QUEUE=0) is
+    # the queue-knob spelling of "off".
+    walk_async = (backend_is_jax and sched is None
+                  and engine.mesh is None and walk_async_enabled())
+    if walk_async:
+        from racon_tpu.ops.budget import walk_queue_env
+        want_q = walk_queue_env(depth)
+        walk_async = want_q > 0
+    else:
+        want_q = 0
+    meter = _WalkOverlapMeter()
+
     tracker = SliceTracker()
     pipe = Pipeline("polish")
     q_pack = pipe.queue("pack", depth)
     q_put = pipe.queue("put", depth)
     q_run = pipe.queue("run", depth)
+    # The walk stage is always in the graph (fused items pass through);
+    # its queue capacity bounds in-flight walk inputs — the per-item
+    # admission check below additionally clamps by plane bytes.
+    q_walk = pipe.queue("walk", max(want_q, 1))
     q_done = pipe.queue("done", max(2 * depth, 4))
+
+    n_slices = (n + chunk - 1) // chunk
 
     def build():
         for sid, s in enumerate(range(0, n, chunk)):
@@ -297,6 +393,15 @@ def stream_consensus(engine, windows, chunk: int = 8192,
                     items.append(_Item("host", sid, host))
             elif active:
                 items.append(_Item("host", sid, active))
+            # The stream's final chunk item has no following forward to
+            # hide behind — it dispatches fused. (A chunk-free final
+            # slice merely costs the PREVIOUS chunk its overlap: the
+            # meter just never sees another fwd in flight.)
+            if sid == n_slices - 1:
+                for it in reversed(items):
+                    if it.kind == "chunk":
+                        it.last = True
+                        break
             # Register BEFORE emitting: an item can only retire after
             # its slice is known to the tracker.
             tracker.register(sid, s, min(s + chunk, n), len(items))
@@ -322,7 +427,7 @@ def stream_consensus(engine, windows, chunk: int = 8192,
         # a slice or change emitted bytes.
         with host_lock:
             engine._degrade(item.windows, exc)
-        item.plan = item.bufs = None
+        item.plan = item.bufs = item.fwd = None
 
     def h2d(item: _Item) -> Optional[_Item]:
         from racon_tpu.ops.device_poa import put_chunk_bufs
@@ -338,10 +443,48 @@ def stream_consensus(engine, windows, chunk: int = 8192,
             return None
         return item
 
+    def admit_async(item: _Item) -> bool:
+        # Per-item decoupling decision: never the last chunk, and the
+        # queued planes of want_q chunks PLUS the one being walked must
+        # fit the aggregate walk-queue budget at this geometry.
+        if not walk_async or item.last:
+            return False
+        from racon_tpu.ops.budget import walk_queue_depth
+        from racon_tpu.ops.device_poa import walk_plane_bytes_for
+        pb = walk_plane_bytes_for(
+            item.plan, ins_scale=engine._round_scales(
+                engine.refine_rounds + 1),
+            rounds=engine.refine_rounds + 1)
+        return walk_queue_depth(pb, want_q + 1) >= want_q + 1
+
     def compute(item: _Item) -> _Item:
-        from racon_tpu.ops.device_poa import collect_chunk, dispatch_chunk
+        from racon_tpu.ops.device_poa import (collect_chunk,
+                                              dispatch_chunk,
+                                              dispatch_chunk_fwd)
         from racon_tpu.resilience.retry import RetryExhausted
         trunc: List = []
+        if admit_async(item):
+            # Decoupled path: dispatch the forward half only and hand
+            # the in-flight planes to the walk stage — this thread is
+            # immediately free to dispatch the NEXT chunk's forward
+            # while the walk stage synchronizes on this one's walk.
+            try:
+                with tracer.span("chunk", f"chunk{item.sid}.{item.gid}",
+                                 windows=len(item.windows),
+                                 lanes=item.plan.B,
+                                 jobs=item.plan.n_jobs):
+                    item.fwd = dispatch_chunk_fwd(
+                        item.plan, match=engine.match,
+                        mismatch=engine.mismatch, gap=engine.gap,
+                        ins_scale=engine._round_scales(
+                            engine.refine_rounds + 1),
+                        rounds=engine.refine_rounds + 1,
+                        bufs=item.bufs)
+            except RetryExhausted as exc:
+                degrade(item, exc)
+                return item
+            meter.fwd_dispatched((item.sid, item.gid))
+            return item
         try:
             with tracer.span("chunk", f"chunk{item.sid}.{item.gid}",
                              windows=len(item.windows),
@@ -361,6 +504,7 @@ def stream_consensus(engine, windows, chunk: int = 8192,
         except RetryExhausted as exc:
             degrade(item, exc)
             return item
+        meter.note_fused()
         engine._apply_group(item.windows, codes, covs, trunc)
         if trunc:
             with host_lock:
@@ -368,10 +512,42 @@ def stream_consensus(engine, windows, chunk: int = 8192,
         item.plan = item.bufs = None    # drop HBM references promptly
         return item
 
+    def walk(item: _Item) -> _Item:
+        # Fused/host/degraded items pass through untouched — the stage
+        # only finishes chunks whose forward went out decoupled.
+        if item.fwd is None:
+            return item
+        from racon_tpu.ops.colwalk import dispatch_walk
+        from racon_tpu.ops.device_poa import collect_chunk
+        from racon_tpu.resilience.retry import RetryExhausted
+        key = (item.sid, item.gid)
+        trunc: List = []
+        try:
+            meter.walk_begin(key)
+            try:
+                with tracer.span("walk", f"walk{item.sid}.{item.gid}",
+                                 lanes=item.plan.B,
+                                 windows=len(item.windows)):
+                    fwd_out, fmeta = item.fwd
+                    packed = dispatch_walk(item.plan, fwd_out, fmeta)
+                    codes, covs = collect_chunk(item.plan, packed)
+            finally:
+                meter.walk_end(key)
+        except RetryExhausted as exc:
+            degrade(item, exc)
+            return item
+        engine._apply_group(item.windows, codes, covs, trunc)
+        if trunc:
+            with host_lock:
+                engine._redo_trunc(trunc)
+        item.plan = item.bufs = item.fwd = None
+        return item
+
     pipe.source("build", build, q_pack)
     pipe.stage("pack", pack, q_pack, q_put)
     pipe.stage("h2d", h2d, q_put, q_run)
-    pipe.stage("compute", compute, q_run, q_done)
+    pipe.stage("compute", compute, q_run, q_walk)
+    pipe.stage("walk", walk, q_walk, q_done)
 
     t0 = time.perf_counter()
     last_end = 0
@@ -423,3 +599,6 @@ def stream_consensus(engine, windows, chunk: int = 8192,
                     yield (last_end, n)
     finally:
         record_pipeline_wall(time.perf_counter() - t0)
+        if backend_is_jax:
+            record_walk(meter.walk_s, meter.overlap_s, meter.dispatches,
+                        meter.fused, q_walk.peak_depth, walk_async)

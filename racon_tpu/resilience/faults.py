@@ -98,7 +98,7 @@ _ACTIONS = ("raise", "kill", "term", "int", "torn", "hang", "stall")
 SITES = (
     "ckpt/commit", "ckpt/manifest",
     "d2h/align", "d2h/chunk", "d2h/sp",
-    "dispatch/chunk",
+    "dispatch/chunk", "dispatch/walk",
     "dist/claim", "dist/contig", "dist/merge", "dist/merge_write",
     "dist/shard", "dist/split",
     "h2d/align", "h2d/chunk", "h2d/repack",

@@ -25,6 +25,14 @@ of its detection round's votes — bit-identical to the fixed engine's
 last round (the replay argument lives in sched/rounds.py). Overflowed
 windows freeze immediately too: their sticky flag already routes them
 to the unbounded host redo, so further device rounds are wasted work.
+
+This path keeps FUSED forward+walk dispatches: every round's walk
+feeds the per-round convergence flag pull, so no walk here is free of
+dependent anchor state — the decoupled-walk stage
+(pipeline/streaming.py, ops/colwalk.py::dispatch_walk) applies only to
+the fixed-round engine, whose FINAL walk nothing consumes until
+retirement. stream_consensus falls back to fused dispatches whenever
+this scheduler is active.
 """
 
 from __future__ import annotations

@@ -142,6 +142,10 @@ declare("RACON_TPU_PIPELINE", "", "flag", "PIPELINE.md",
         "streaming pipeline gate (see pipeline/__init__ truth table)")
 declare("RACON_TPU_PIPELINE_DEPTH", "", "int", "PIPELINE.md",
         "bounded-queue capacity per stage edge")
+declare("RACON_TPU_WALK_ASYNC", "", "flag", "PIPELINE.md",
+        "decoupled walk dispatches (0 forces fused forward+walk)")
+declare("RACON_TPU_WALK_QUEUE", "", "int", "PIPELINE.md",
+        "in-flight walk-input queue depth (budget-clamped)")
 
 # docs/RESILIENCE.md — faults, retry, watchdog, deadlines
 declare("RACON_TPU_DEADLINE_CELLS_PER_S", "", "float", "RESILIENCE.md",

@@ -13,6 +13,13 @@ Runs the band forward (XLA twin, any backend) once per (Lq, k), then
 times col_walk at each depth and checks bit-identity of the
 unflagged-lane channels against the k=1 reference — the ratio isolates
 lever 1 of round 6 (and round 8's k=4 extension) from kernel cost.
+
+A second section ablates the decoupled walk dispatch (ISSUE 14): the
+same synthetic stream run twice through the pipeline executor, once
+with RACON_TPU_WALK_ASYNC=1 (chunk N's final-round walk dispatched as
+its own executable, overlapping chunk N+1's forward rounds) and once
+fused, printing wall seconds, walk seconds, the measured
+walk_hidden_fraction, and bit-identity of the consensi.
 """
 
 import os
@@ -24,6 +31,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 KS = (1, 2, 4)
+
+BASES = np.frombuffer(b"ACGT", np.uint8)
+
+_STREAM_ENVS = ("RACON_TPU_SCHED", "RACON_TPU_PIPELINE",
+                "RACON_TPU_WALK_ASYNC")
 
 
 def t(fn, *args, reps=10):
@@ -108,6 +120,81 @@ def main():
         print(row)
         if not bitid:
             sys.exit(1)
+    decoupled_mode()
+
+
+def _mutate(rng, truth):
+    out = []
+    for b in truth:
+        r = rng.random()
+        if r < 0.04:
+            continue
+        out.append(int(BASES[rng.integers(0, 4)]) if r < 0.08 else int(b))
+        if r > 0.96:
+            out.append(int(BASES[rng.integers(0, 4)]))
+    return bytes(out)
+
+
+def _build_windows(n, seed=0, coverage=5, wlen=80):
+    from racon_tpu.models.window import Window, WindowType
+    rng = np.random.default_rng(seed)
+    ws = []
+    for i in range(n):
+        truth = BASES[rng.integers(0, 4, wlen)]
+        backbone = _mutate(rng, truth)
+        qual = bytes(rng.integers(43, 63, len(backbone), dtype=np.uint8))
+        w = Window(i, i % 7, WindowType.TGS, backbone, qual)
+        for _ in range(coverage):
+            lay = _mutate(rng, truth)
+            lq = bytes(rng.integers(43, 63, len(lay), dtype=np.uint8))
+            w.add_layer(lay, lq, 0, len(backbone) - 1)
+        ws.append(w)
+    return ws
+
+
+def _stream_once(seed):
+    from racon_tpu.obs import metrics as obs_metrics
+    from racon_tpu.ops.poa import PoaEngine
+    from racon_tpu.pipeline.streaming import stream_consensus
+
+    obs_metrics.reset()
+    ws = _build_windows(32, seed=seed)
+    t0 = time.perf_counter()
+    list(stream_consensus(PoaEngine(backend="jax"), ws, chunk=8, depth=2))
+    wall = time.perf_counter() - t0
+    snap = obs_metrics.registry().snapshot()
+    return [w.consensus for w in ws], snap, wall
+
+
+def decoupled_mode():
+    """Decoupled-vs-fused walk dispatch through the pipeline executor."""
+    saved = {k: os.environ.get(k) for k in _STREAM_ENVS}
+    os.environ["RACON_TPU_SCHED"] = "0"
+    os.environ["RACON_TPU_PIPELINE"] = "1"
+    try:
+        print("\ndecoupled walk dispatch (streamed, 4 chunks, depth=2)")
+        print(f"{'mode':>10} {'wall_s':>8} {'walk_s':>8} "
+              f"{'hidden':>7} {'dispatches':>10}")
+        os.environ["RACON_TPU_WALK_ASYNC"] = "1"
+        dec, dsnap, dwall = _stream_once(33)
+        print(f"{'decoupled':>10} {dwall:>8.3f} "
+              f"{dsnap.get('walk_seconds', 0.0):>8.3f} "
+              f"{dsnap.get('walk_hidden_fraction', 0.0):>7.3f} "
+              f"{dsnap.get('walk_dispatches', 0):>10}")
+        os.environ["RACON_TPU_WALK_ASYNC"] = "0"
+        fus, fsnap, fwall = _stream_once(33)
+        print(f"{'fused':>10} {fwall:>8.3f} {'-':>8} {'-':>7} "
+              f"{fsnap.get('walk_dispatches', 0):>10}")
+        bitid = dec == fus
+        print(f"{'bitid':>10} {'PASS' if bitid else 'FAIL':>8}")
+        if not bitid or dsnap.get("walk_dispatches", 0) < 1:
+            sys.exit(1)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
 
 
 if __name__ == "__main__":

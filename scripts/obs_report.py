@@ -59,6 +59,10 @@ KIND_REQUIRED_ATTRS = {
     # obs/metrics.py parse/<reader>): which plan ran and how many
     # decompressed/raw bytes it moved.
     "ingest": ("mode", "bytes"),
+    # One decoupled final-round walk dispatch (pipeline/streaming.py
+    # walk stage over ops/colwalk.py::dispatch_walk): geometry of the
+    # chunk whose traceback it finishes.
+    "walk": ("lanes", "windows"),
 }
 
 # Span kinds that carry no required attributes — structural intervals

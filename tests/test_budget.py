@@ -207,3 +207,55 @@ def test_tile_plan_walk_depth_per_tier():
             assert plan.lanes * plan.Lq * plan.W <= budget.max_dir_elems(2)
             assert budget.vmem_est(plan.W, plan.T, plan.ch, 4) \
                 <= budget.VMEM_BUDGET
+
+
+# ---------------------------------------- decoupled-walk queue budget
+
+
+def test_walk_plane_bytes_per_depth():
+    # u8 dirs always; +u8 nxt at k>=2; +u16 nxt2 at k>=4 — 1/2/4 bytes
+    # per cell by walk depth.
+    assert budget.walk_plane_bytes(1024, 512, 256, 1) == 1024 * 512 * 256
+    assert budget.walk_plane_bytes(1024, 512, 256, 2) \
+        == 2 * 1024 * 512 * 256
+    assert budget.walk_plane_bytes(1024, 512, 256, 4) \
+        == 4 * 1024 * 512 * 256 == 536_870_912
+    # Bench consensus geometry at the narrowed final band (W=192, k=4):
+    # one queued chunk parks ~1.0 GB of planes.
+    assert budget.walk_plane_bytes(2048, 640, 192, 4) == 1_006_632_960
+
+
+def test_walk_queue_budget_pins():
+    # Same 9/10-margin discipline as the single-buffer caps: the queue
+    # gets one 2 GB buffer's worth of HBM, shared across queued chunks.
+    assert budget.WALK_QUEUE_BYTES == 1_932_735_283
+    bench_pb = budget.walk_plane_bytes(2048, 640, 192, 4)
+    # Bench geometry admits exactly ONE queued chunk — the classic
+    # depth-2 pipeline still overlaps (one walking + one queued is
+    # checked as want+1 by the streaming admission).
+    assert budget.walk_queue_depth(bench_pb, 4) == 1
+    assert budget.walk_queue_depth(bench_pb, 1) == 1
+    # Small geometries keep the requested depth.
+    small = budget.walk_plane_bytes(256, 128, 192, 4)
+    assert budget.walk_queue_depth(small, 2) == 2
+    # want <= 0 is off; an oversized plane clamps to 0, never admits.
+    assert budget.walk_queue_depth(bench_pb, 0) == 0
+    assert budget.walk_queue_depth(budget.WALK_QUEUE_BYTES + 1, 3) == 0
+    assert budget.walk_queue_depth(0, 3) == 3
+
+
+def test_walk_queue_env_validation(monkeypatch):
+    monkeypatch.delenv(budget.WALK_QUEUE_ENV, raising=False)
+    assert budget.walk_queue_env(2) == 2           # empty -> default
+    monkeypatch.setenv(budget.WALK_QUEUE_ENV, "3")
+    assert budget.walk_queue_env(2) == 3
+    monkeypatch.setenv(budget.WALK_QUEUE_ENV, "0")
+    assert budget.walk_queue_env(2) == 0           # explicit off
+    for bad in ("-1", "two"):
+        monkeypatch.setenv(budget.WALK_QUEUE_ENV, bad)
+        try:
+            budget.walk_queue_env(2)
+        except ValueError:
+            pass
+        else:
+            raise AssertionError(f"{bad!r} must be rejected")

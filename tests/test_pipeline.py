@@ -422,3 +422,34 @@ def test_pipeline_differential_golden(ref_data, monkeypatch, reads,
     piped = run()
     assert [s.data for s in piped] == [s.data for s in serial]
     assert [s.name for s in piped] == [s.name for s in serial]
+
+
+@pytest.mark.ava
+@pytest.mark.parametrize("reads,overlaps,window,scores",
+                         _GOLDEN_CONFIGS, ids=_GOLDEN_IDS)
+def test_walk_async_differential_golden(ref_data, monkeypatch, reads,
+                                        overlaps, window, scores):
+    """RACON_TPU_WALK_ASYNC=0 and =1 must produce bit-identical
+    polished FASTA on every reference acceptance config, on the path
+    where the decoupled walk actually runs (pipeline on, fixed rounds —
+    the scheduler keeps fused dispatches, see sched/scheduler.py). The
+    walk dispatch composes the same traced bodies the fused program
+    compiles, so any divergence is a handoff bug, not noise."""
+    from racon_tpu.models.polisher import PolisherType, create_polisher
+
+    def run():
+        p = create_polisher(
+            ref_data(reads), ref_data(overlaps),
+            ref_data("sample_layout.fasta.gz"), PolisherType.kC,
+            window, 10.0, 0.3, *scores, backend="jax")
+        p.initialize()
+        return p.polish(True)
+
+    monkeypatch.setenv("RACON_TPU_PIPELINE", "1")
+    monkeypatch.setenv("RACON_TPU_SCHED", "0")
+    monkeypatch.setenv("RACON_TPU_WALK_ASYNC", "0")
+    fused = run()
+    monkeypatch.setenv("RACON_TPU_WALK_ASYNC", "1")
+    decoupled = run()
+    assert [s.data for s in decoupled] == [s.data for s in fused]
+    assert [s.name for s in decoupled] == [s.name for s in fused]
