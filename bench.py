@@ -150,6 +150,62 @@ def _ingest_bench() -> dict:
     return out
 
 
+def _serve_bench(backend: str, coverage: int, wlen: int) -> dict:
+    """Serve-plane micro-bench (metric_version 13): three concurrent
+    jobs from two tenants push their windows through one
+    CrossRequestBatcher over a warm engine (racon_tpu/server/batch.py),
+    consensi asserted identical to a solo serial pass of the same
+    windows — the per-window determinism invariant the daemon's
+    byte-identity rests on, exercised at bench geometry. Publishes
+    serve_jobs_per_min / serve_batch_occupancy and the rest of the
+    serve_* registry extras (batches, windows, tenant wait, queue
+    peak)."""
+    import threading
+    from racon_tpu.obs import metrics as obs_metrics
+    from racon_tpu.ops.poa import PoaEngine
+    from racon_tpu.server.batch import CrossRequestBatcher
+
+    n_per_job = 16
+    jobs = [("j1", "acme"), ("j2", "acme"), ("j3", "umbrella")]
+    total = n_per_job * len(jobs)
+    ref = build_windows(total, coverage, wlen, seed=23)
+    PoaEngine(backend=backend).consensus_windows(ref)
+    shared = build_windows(total, coverage, wlen, seed=23)
+    # Capacity fits all three jobs' windows in one dispatch; the 1 s
+    # staging window absorbs thread-start skew so the batch actually
+    # merges across jobs (occupancy ~1.0 when it does).
+    batcher = CrossRequestBatcher(PoaEngine(backend=backend),
+                                  capacity=total, wait_s=1.0)
+    batcher.start()
+    results: dict = {}
+
+    def _job(idx: int, job_id: str, tenant: str) -> None:
+        lo = idx * n_per_job
+        results[job_id] = batcher.consensus(
+            job_id, tenant, shared[lo:lo + n_per_job])
+
+    threads = [threading.Thread(target=_job, args=(i, j, t),
+                                name=f"serve-bench-{j}")
+               for i, (j, t) in enumerate(jobs)]
+    t0 = time.perf_counter()
+    try:
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+    finally:
+        dt = time.perf_counter() - t0
+        batcher.close()
+    assert sum(results.values()) == total
+    assert [w.consensus for w in shared] == [w.consensus for w in ref], \
+        "batched serve consensus diverged from solo serial"
+    obs_metrics.set_serve_rate(len(jobs) / (dt / 60.0))
+    out = dict(obs_metrics.serve_extras())
+    out["serve_bench_jobs"] = len(jobs)
+    out["serve_bench_seconds"] = round(dt, 4)
+    return out
+
+
 def main():
     from racon_tpu.utils.jaxcache import enable_compile_cache
     enable_compile_cache()
@@ -389,13 +445,27 @@ def main():
         dp_extras = {k: v for k, v in dp_extras.items()
                      if k.startswith("dp_")}
     ingest_bench_extras = _ingest_bench()
+    serve_bench_extras = _serve_bench(backend, coverage, wlen)
     extras = {**sched_extras, **e2e_transfers, **pipe_extras,
               **walk_bench_extras, **probe_extras, **adaptive_extras,
               **cache_extras(), **obs_metrics.resilience_extras(),
               **obs_metrics.ovl_extras(), **obs_metrics.dist_extras(),
               **obs_metrics.redo_extras(), **obs_metrics.ingest_extras(),
-              **ingest_bench_extras, **dp_extras}
+              **ingest_bench_extras, **serve_bench_extras, **dp_extras}
     out = {
+        # metric_version 13: same primary value as versions 2-12 (the
+        # compute bench is untouched — the serve plane wraps the same
+        # engine, it does not change it). New in 13: the serve_* extras
+        # from the cross-request batcher micro-bench (_serve_bench;
+        # three concurrent jobs from two tenants through one
+        # racon_tpu/server/batch.py batcher over a warm engine,
+        # consensi asserted identical to a solo serial pass) —
+        # serve_jobs_per_min (wall throughput of the 3-job drill),
+        # serve_batch_occupancy (windows per dispatch / capacity, ~1.0
+        # when the jobs' windows actually co-ride), serve_batches,
+        # serve_batch_windows, serve_tenant_wait_s,
+        # serve_queue_depth_peak, plus serve_bench_jobs /
+        # serve_bench_seconds describing the drill itself.
         # metric_version 12: same primary value as versions 2-11 (the
         # compute bench still times the fused production chunk). New in
         # 12: the decoupled-walk stream comparison — the workload runs
@@ -492,7 +562,7 @@ def main():
         # fixed_engine_windows_per_sec. Bump this whenever the primary
         # value's definition changes, so round-over-round comparisons
         # can't silently mix metrics.
-        "metric_version": 12,
+        "metric_version": 13,
         "metric": f"POA windows/sec/chip, compute-only (direct-timed warm "
                   f"production chunk, convergence-scheduled refinement "
                   f"rounds — racon_tpu/sched/, telemetry in sched_* "

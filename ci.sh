@@ -80,4 +80,7 @@ python scripts/chaos_bench.py --smoke
 echo "[ci] ingest smoke (parallel inflate plans, gz+plain 4-way byte-diff, ingest spans validate)"
 python scripts/ingest_smoke.py
 
+echo "[ci] server smoke (daemon, 3 jobs/2 tenants, cross-request occupancy > solo, kill+restart byte-diff)"
+python scripts/server_smoke.py
+
 echo "[ci] OK"

@@ -198,9 +198,10 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     from racon_tpu.models.overlap import PolisherError
     from racon_tpu.io.parsers import ParseError
-    from racon_tpu.models.polisher import PolisherType, create_polisher
     from racon_tpu.pipeline import configure as configure_pipeline
     from racon_tpu.pipeline import pipeline_enabled
+    from racon_tpu.server.engine import JobHooks, JobSpec, build_polisher
+    from racon_tpu.server.engine import polish_job
     from racon_tpu.utils.logger import Logger
 
     try:
@@ -280,20 +281,22 @@ def main(argv: Optional[List[str]] = None) -> int:
         finally:
             tracer.finish()
     # Everything that changes emitted bytes goes into the run
-    # fingerprint (checkpoint and ledger identity alike); backend /
-    # mesh / pipeline knobs are excluded because the execution paths
-    # are bit-identical by design.
-    ckpt_config = {
-        "version": __version__,
-        "include_unpolished": bool(args.include_unpolished),
-        "fragment_correction": bool(args.fragment_correction),
-        "window_length": args.window_length,
-        "quality_threshold": args.quality_threshold,
-        "error_threshold": args.error_threshold,
-        "match": args.match,
-        "mismatch": args.mismatch,
-        "gap": args.gap,
-    }
+    # fingerprint (checkpoint and ledger identity alike) — single
+    # source: JobSpec.identity() (racon_tpu/server/engine.py), which
+    # the daemon's job journal shares, so a daemon job and a solo CLI
+    # run agree on what "the same run" means. Backend / mesh /
+    # pipeline knobs are excluded because the execution paths are
+    # bit-identical by design.
+    spec = JobSpec(
+        args.paths[0], args.paths[1], args.paths[2],
+        include_unpolished=args.include_unpolished,
+        fragment_correction=args.fragment_correction,
+        window_length=args.window_length,
+        quality_threshold=args.quality_threshold,
+        error_threshold=args.error_threshold, match=args.match,
+        mismatch=args.mismatch, gap=args.gap, backend=args.backend,
+        threads=args.threads)
+    ckpt_config = spec.identity()
     if args.checkpoint_dir:
         from racon_tpu.resilience.checkpoint import (CheckpointError,
                                                      CheckpointStore,
@@ -321,7 +324,6 @@ def main(argv: Optional[List[str]] = None) -> int:
             old_handlers[s] = signal.signal(s, _on_signal)
 
     from racon_tpu.obs import fleet
-    from racon_tpu.obs.metrics import record_ckpt
     from racon_tpu.obs.metrics import registry as obs_registry
     rc = 0
 
@@ -337,14 +339,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         tracer.set_context(worker_id=wid, run_fp=fp)
 
     def make_polisher():
-        return create_polisher(
-            args.paths[0], args.paths[1], args.paths[2],
-            PolisherType.kF if args.fragment_correction
-            else PolisherType.kC,
-            args.window_length, args.quality_threshold,
-            args.error_threshold, args.match, args.mismatch, args.gap,
-            backend=args.backend, logger=logger, threads=args.threads,
-            mesh=mesh)
+        return build_polisher(spec, logger=logger, mesh=mesh)
 
     try:
         with tracer.span("run", "racon_tpu"):
@@ -369,53 +364,22 @@ def main(argv: Optional[List[str]] = None) -> int:
                     drop_unpolished=not args.include_unpolished,
                     out=out)
             else:
-                polisher = make_polisher()
-                polisher.initialize()
-                if store is not None and store.committed:
-                    n_skip = polisher.skip_targets(store.committed)
+                # The serial frontend is now a thin call into the
+                # shared engine loop (racon_tpu/server/engine.py):
+                # resume pruning, stored-blob re-emission interleaved
+                # with fresh records in input order, and durable
+                # per-contig commits all live there — one
+                # implementation for CLI, ledger worker, and daemon.
+                def _resume_log(n_committed: int, n_skip: int) -> None:
                     if n_skip:
                         print("[racon_tpu::] resume: skipping "
                               f"recompute of {n_skip} window(s)",
                               file=sys.stderr)
-                n_targets = polisher._targets_size
-                next_tid = 0
 
-                def emit_stored(limit: int) -> None:
-                    # Re-emit committed contigs (exact shard bytes)
-                    # for every target slot before `limit` —
-                    # interleaving stored and freshly polished targets
-                    # in input order keeps resumed stdout
-                    # byte-identical to a fresh run.
-                    nonlocal next_tid
-                    while next_tid < limit:
-                        if store is not None and \
-                                next_tid in store.committed:
-                            blob = store.read_emitted(next_tid)
-                            if blob is not None:
-                                out.write(blob)
-                            record_ckpt("skip", next_tid,
-                                        len(blob) if blob else 0)
-                        next_tid += 1
-
-                # Each contig is written the moment its last window
-                # retires (with the pipeline on, while later windows
-                # still flow through it — emission overlaps compute),
-                # then durably committed before the next one is
-                # handled.
-                for tid, rec in polisher.polish_records(
-                        not args.include_unpolished):
-                    emit_stored(tid)
-                    if rec is not None:
-                        out.write(b">" + rec.name.encode() + b"\n" +
-                                  rec.data + b"\n")
-                    if store is not None:
-                        if rec is not None:
-                            store.commit(tid, rec.name.encode(),
-                                         rec.data)
-                        else:
-                            store.commit_dropped(tid)
-                    next_tid = tid + 1
-                emit_stored(n_targets)
+                polish_job(make_polisher,
+                           drop_unpolished=not args.include_unpolished,
+                           store=store, emit=out.write,
+                           hooks=JobHooks(on_resume=_resume_log))
     except (PolisherError, ParseError, ValueError) as exc:
         print(str(exc), file=sys.stderr)
         return 1

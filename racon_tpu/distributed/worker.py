@@ -49,6 +49,7 @@ from racon_tpu.obs.metrics import record_dist, set_dist
 from racon_tpu.obs.trace import get_tracer
 from racon_tpu.resilience import checkpoint as ckpt
 from racon_tpu.resilience.faults import maybe_fault
+from racon_tpu.server.engine import JobHooks, polish_job
 
 ENV_POLL = "RACON_TPU_DIST_POLL"
 ENV_AVOID = "RACON_TPU_DIST_AVOID"
@@ -148,7 +149,16 @@ def _polish_shard(ledger: WorkLedger, claim: Claim,
                   t_shard: float) -> int:
     """Polish one claimed shard to completion; returns the number of
     committed targets in the shard's final effective range. Raises
-    LeaseLost the moment the lease is observed stolen."""
+    LeaseLost the moment the lease is observed stolen.
+
+    The loop itself is the shared engine's ``polish_job``
+    (racon_tpu/server/engine.py) — this frontend contributes only the
+    ledger-specific hooks: lease renewal per contig, the ``dist/*``
+    fault drills, dist accounting, and the dynamic split protocol
+    (``claim.info.end`` shrinks mid-run when a starved fleet steals
+    the uncommitted tail, which the hooks surface as the loop's live
+    range end).
+    """
     info = claim.info
     store = _open_store(ledger, info)
     try:
@@ -162,51 +172,41 @@ def _polish_shard(ledger: WorkLedger, claim: Claim,
                   f"{info.name} resumes {len(store.committed)}/"
                   f"{info.end - start} committed contig(s) from "
                   "previous holder", file=log)
-        next_tid = start
-        while next_tid in store.committed:
-            next_tid += 1
-        # Claim-time trigger: splitting BEFORE the polisher is built
-        # means the donated range's windows are never constructed here
-        # — in serial engine mode all consensus compute runs up-front,
-        # so this is the evaluation that actually shortens the tail.
-        if next_tid < info.end:
-            _maybe_split(ledger, claim, next_tid, t_shard, log)
-        if any(tid not in store.committed
-               for tid in range(start, info.end)):
-            polisher = make_polisher()
-            polisher.initialize()
-            polisher.restrict_targets(range(start, info.end))
-            if store.committed:
-                polisher.skip_targets(store.committed)
-            for tid, rec in polisher.polish_records(drop_unpolished):
-                if tid >= info.end:
-                    break  # donated to a split child mid-run
-                maybe_fault("dist/contig")
-                ledger.renew(claim)
-                # Per-contig cadence: cheap (interval-gated) and tied
-                # to the same heartbeat the lease renewal proves, so a
-                # live worker's metric shard is never staler than its
-                # lease.
-                fleet.maybe_flush()
-                if rec is not None:
-                    store.commit(tid, rec.name.encode(), rec.data)
-                else:
-                    store.commit_dropped(tid)
-                record_dist("contigs_polished", claim.shard,
+
+        def _before_build(first_tid: int) -> None:
+            # Claim-time trigger: splitting BEFORE the polisher is
+            # built means the donated range's windows are never
+            # constructed here — in serial engine mode all consensus
+            # compute runs up-front, so this is the evaluation that
+            # actually shortens the tail.
+            _maybe_split(ledger, claim, first_tid, t_shard, log)
+
+        def _before_commit(tid: int, rec) -> None:
+            maybe_fault("dist/contig")
+            ledger.renew(claim)
+            # Per-contig cadence: cheap (interval-gated) and tied to
+            # the same heartbeat the lease renewal proves, so a live
+            # worker's metric shard is never staler than its lease.
+            fleet.maybe_flush()
+
+        def _after_commit(tid: int, rec) -> None:
+            record_dist("contigs_polished", claim.shard, claim.worker,
+                        tid=tid)
+            if claim.stolen:
+                record_dist("contigs_repolished", claim.shard,
                             claim.worker, tid=tid)
-                if claim.stolen:
-                    record_dist("contigs_repolished", claim.shard,
-                                claim.worker, tid=tid)
-                if tid + 1 < info.end:
-                    _maybe_split(ledger, claim, tid + 1, t_shard, log)
-        # Targets with zero windows never reach the assembler, so they
-        # yield nothing above — commit them as drops explicitly so the
-        # done marker really means "every tid in range accounted for".
-        for tid in range(start, info.end):
-            if tid not in store.committed:
-                ledger.renew(claim)
-                store.commit_dropped(tid)
-        return info.end - start
+            if tid + 1 < claim.info.end:
+                _maybe_split(ledger, claim, tid + 1, t_shard, log)
+
+        return polish_job(
+            make_polisher, drop_unpolished=drop_unpolished,
+            store=store, tid_range=(start, info.end), fill_drops=True,
+            hooks=JobHooks(
+                range_end=lambda default: claim.info.end,
+                before_build=_before_build,
+                before_commit=_before_commit,
+                after_commit=_after_commit,
+                before_fill=lambda tid: ledger.renew(claim)))
     finally:
         store.close()
 

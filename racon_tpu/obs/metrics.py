@@ -583,6 +583,81 @@ def walk_extras(reg: Optional[MetricsRegistry] = None
     return out
 
 
+# ---------------------------------------------------------- serve plane
+
+def record_serve_job(event: str, job: str, tenant: str,
+                     reg: Optional[MetricsRegistry] = None) -> None:
+    """Account one daemon job-lifecycle event (racon_tpu/server/):
+    ``submitted`` / ``completed`` / ``failed`` / ``cancelled`` /
+    ``resumed`` — each lands as the counter ``serve_jobs_<event>``
+    plus a ``serve`` trace span carrying the job id and tenant."""
+    reg = reg if reg is not None else _REGISTRY
+    reg.inc(f"serve_jobs_{event}")
+    _trace.get_tracer().point("serve", event, job=str(job),
+                              tenant=str(tenant))
+
+
+def record_serve_batch(n_windows: int, capacity: int, jobs, tenants,
+                       wait_s: float,
+                       reg: Optional[MetricsRegistry] = None) -> None:
+    """Account one cross-request batch dispatch
+    (racon_tpu/server/batch.py): windows carried, the jobs/tenants that
+    contributed, and the summed staging wait its items paid. The
+    derived ``serve_batch_occupancy`` gauge — mean windows per dispatch
+    over the bucket capacity — is the headline: strictly higher under
+    concurrent jobs than one-at-a-time is the server smoke's
+    acceptance gate."""
+    reg = reg if reg is not None else _REGISTRY
+    cap = max(int(capacity), 1)
+
+    def _mutate(v):
+        # One lock for the whole read-modify-write: the occupancy ratio
+        # must be derived from the same totals its increments produced.
+        v["serve_batches"] = v.get("serve_batches", 0) + 1
+        v["serve_batch_windows"] = \
+            v.get("serve_batch_windows", 0) + int(n_windows)
+        v["serve_tenant_wait_s"] = \
+            v.get("serve_tenant_wait_s", 0.0) + float(wait_s)
+        v["serve_batch_occupancy"] = round(
+            v["serve_batch_windows"] / (v["serve_batches"] * cap), 4)
+
+    reg.apply(_mutate)
+    _trace.get_tracer().point("serve", "batch",
+                              job=",".join(str(j) for j in jobs),
+                              tenant=",".join(str(t) for t in tenants),
+                              windows=int(n_windows), capacity=cap,
+                              wait_s=round(float(wait_s), 6))
+
+
+def set_serve_active(n: int,
+                     reg: Optional[MetricsRegistry] = None) -> None:
+    """Set the daemon's in-flight job gauge (submitted or running,
+    not yet terminal)."""
+    reg = reg if reg is not None else _REGISTRY
+    reg.set("serve_active_jobs", int(n))
+
+
+def set_serve_rate(jobs_per_min: float,
+                   reg: Optional[MetricsRegistry] = None) -> None:
+    """Set the daemon's completion-rate gauge (completed jobs over
+    daemon uptime minutes; recomputed at each completion)."""
+    reg = reg if reg is not None else _REGISTRY
+    reg.set("serve_jobs_per_min", round(float(jobs_per_min), 4))
+
+
+def serve_extras(reg: Optional[MetricsRegistry] = None
+                 ) -> Dict[str, object]:
+    """The registry's serve_* keys as a JSON-ready dict (bench extras
+    metric_version 13 / obs_report "server:" section). Empty when no
+    daemon/batcher ran, so CLI runs stay quiet."""
+    reg = reg if reg is not None else _REGISTRY
+    out: Dict[str, object] = {}
+    for k, v in sorted(reg.snapshot().items()):
+        if k.startswith("serve_"):
+            out[k] = round(v, 4) if isinstance(v, float) else v
+    return out
+
+
 # ------------------------------------------------------- sched telemetry
 
 #: Canonical sched_* registry keys (docs/SCHEDULER.md documents each).
@@ -656,6 +731,10 @@ _MERGE_LAST_KEYS = frozenset({
     # derived hidden fraction — the walk_* second/dispatch counters sum
     # and walk_queue_peak maxes via its suffix.
     "walk_async_enabled", "walk_hidden_fraction",
+    # Daemon gauges (racon_tpu/server/): in-flight jobs, mean batch
+    # occupancy, completion rate — the serve_* event/window counters
+    # sum and serve_queue_depth_peak maxes via its suffix.
+    "serve_active_jobs", "serve_batch_occupancy", "serve_jobs_per_min",
 })
 
 
@@ -755,6 +834,14 @@ METRIC_SPECS = (
     ("sched_survivor_frac", MERGE_LAST, "sched_"),
     ("sched_chunks", MERGE_LAST, "sched_"),
     ("sched_windows", MERGE_LAST, "sched_"),
+    ("serve_active_jobs", MERGE_LAST, "serve_active_jobs"),
+    ("serve_batch_occupancy", MERGE_LAST, "serve_batch_occupancy"),
+    ("serve_batch_windows", MERGE_SUM, "serve_batch_windows"),
+    ("serve_batches", MERGE_SUM, "serve_batches"),
+    ("serve_jobs_per_min", MERGE_LAST, "serve_jobs_per_min"),
+    ("serve_jobs_*", MERGE_SUM, "serve_jobs_"),
+    ("serve_queue_depth_peak", MERGE_MAX, "serve_queue_depth_peak"),
+    ("serve_tenant_wait_s", MERGE_SUM, "serve_tenant_wait_s"),
     ("walk_async_enabled", MERGE_LAST, "walk_async_enabled"),
     ("walk_chain_len", MERGE_LAST, "walk_chain_len"),
     ("walk_dispatches", MERGE_SUM, "walk_dispatches"),

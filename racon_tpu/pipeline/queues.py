@@ -30,6 +30,12 @@ class QueueClosed(Exception):
     """End of stream: the queue was closed and fully drained."""
 
 
+class QueueTimeout(Exception):
+    """``get(timeout=...)`` expired with the queue still empty and
+    open — the caller's cue to act on what it already holds (the
+    server's cross-request batcher flushes a partial batch here)."""
+
+
 class PipelineAborted(RuntimeError):
     """The pipeline failed elsewhere; this queue was torn down."""
 
@@ -76,14 +82,23 @@ class BoundedQueue:
                 self.peak_depth = len(self._items)
             self._not_empty.notify()
 
-    def get(self):
+    def get(self, timeout: Optional[float] = None):
         """Dequeue; blocks while empty. Raises QueueClosed at end of
-        stream, PipelineAborted on teardown (pending items dropped)."""
+        stream, PipelineAborted on teardown (pending items dropped),
+        QueueTimeout when ``timeout`` seconds pass with the queue
+        still empty and open (``timeout=None`` waits forever)."""
         t0 = time.perf_counter()
+        deadline = None if timeout is None else t0 + max(timeout, 0.0)
         with self._not_empty:
             while (not self._items and not self._closed
                    and not self._aborted):
-                self._not_empty.wait(0.1)
+                if deadline is not None:
+                    left = deadline - time.perf_counter()
+                    if left <= 0:
+                        break
+                    self._not_empty.wait(min(0.1, left))
+                else:
+                    self._not_empty.wait(0.1)
             self.get_wait_s += time.perf_counter() - t0
             if self._aborted:
                 raise PipelineAborted(self.name)
@@ -91,7 +106,9 @@ class BoundedQueue:
                 item = self._items.popleft()
                 self._not_full.notify()
                 return item
-            raise QueueClosed(self.name)
+            if self._closed:
+                raise QueueClosed(self.name)
+            raise QueueTimeout(self.name)
 
     # ------------------------------------------------------------- lifecycle
 

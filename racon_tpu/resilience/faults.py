@@ -105,6 +105,7 @@ SITES = (
     "io/inflate", "io/read",
     "obs/snapshot",
     "sched/flags",
+    "serve/commit", "serve/dispatch", "serve/submit",
 )
 
 #: Dynamic site families: one entry per prefix; the concrete site is

@@ -1,0 +1,28 @@
+"""Polishing-as-a-service: the resident daemon and its engine library.
+
+One process, many polishing jobs. The package splits into three layers
+(docs/SERVER.md):
+
+- :mod:`racon_tpu.server.engine` — the embeddable engine API every
+  frontend shares. ``JobSpec`` is the single source of a run's
+  output-affecting identity (the checkpoint fingerprint config),
+  ``polish_job`` is the one resume-aware polish/commit/emit loop, and
+  ``EngineSession`` owns warm compile-cache state so a resident process
+  pays compilation exactly once per shape bucket. The serial CLI and
+  the distributed ledger worker are thin frontends over this module.
+- :mod:`racon_tpu.server.batch` — the admission + cross-request
+  batcher: windows from multiple in-flight jobs pack into one device
+  dispatch so the chip never runs a partial batch just because
+  individual requests are small; per-tenant round-robin keeps one
+  noisy tenant from starving the rest.
+- :mod:`racon_tpu.server.daemon` — the long-lived HTTP daemon:
+  journaled job lifecycle (submit/status/stream/cancel) persisted
+  through the checkpoint store, so a daemon restart — SIGTERM or
+  ``kill -9`` — resumes every in-flight job byte-identically.
+"""
+
+from racon_tpu.server.engine import (EngineSession, JobHooks, JobSpec,
+                                     build_polisher, polish_job)
+
+__all__ = ["EngineSession", "JobHooks", "JobSpec", "build_polisher",
+           "polish_job"]

@@ -1,0 +1,8 @@
+"""``python -m racon_tpu.server`` — launch the resident daemon."""
+
+import sys
+
+from racon_tpu.server.daemon import main
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

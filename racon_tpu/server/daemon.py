@@ -1,0 +1,423 @@
+"""Polishing-as-a-service: the resident multi-tenant daemon.
+
+One long-lived process owns the warm state a one-shot CLI pays for on
+every invocation — the persistent compile cache, the
+:class:`~racon_tpu.server.engine.EngineSession` engine pool, and the
+chunk-shape executables those engines hold — and serves polishing jobs
+over a local HTTP API:
+
+- ``POST /v1/jobs``              submit ``{tenant, sequences, overlaps,
+  targets, options}`` → ``{id}``; the job is journaled before the
+  response leaves (``serve/submit`` fault site).
+- ``GET  /v1/jobs``              list jobs; ``GET /v1/jobs/<id>`` one
+  job's status.
+- ``GET  /v1/jobs/<id>/stream``  the job's FASTA bytes so far —
+  byte-identical to a solo serial CLI run of the same inputs, the
+  server smoke's acceptance gate.
+- ``POST /v1/jobs/<id>/cancel``  cooperative cancel at the next contig
+  boundary (committed work is kept).
+- ``GET  /healthz``              watchdog liveness + a ``serve`` view
+  (job table, active count); anything else serves the OpenMetrics
+  registry render.
+
+Every job runs the SAME engine loop as the CLI (``polish_job``)
+against its own checkpoint store, with the job's device compute routed
+through the shared :class:`~racon_tpu.server.batch.CrossRequestBatcher`
+— many jobs, one dispatch stream, full batches. Restart recovery is
+the checkpoint contract inherited whole: on startup every non-terminal
+journaled job is re-queued (``serve_jobs_resumed``), its committed
+prefix re-emitted from the shard byte-for-byte, and only the remainder
+polished — so SIGKILL mid-job costs at most one uncommitted contig of
+rework and zero output differences.
+
+The daemon forces the in-process streaming pipeline off: concurrency
+comes from jobs sharing the batcher, not from stages inside one job,
+so the dispatcher thread stays the sole owner of device compute.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from racon_tpu.server.batch import BatchedEngineProxy, CrossRequestBatcher
+from racon_tpu.server.engine import (EngineSession, JobHooks, JobSpec,
+                                     build_polisher, polish_job)
+from racon_tpu.server.jobs import (TERMINAL, Job, JobCancelled,
+                                   allocate_id, open_store,
+                                   rebuild_result, scan)
+from racon_tpu.utils import envspec
+from racon_tpu.utils.atomicio import atomic_write_text
+
+ENV_MAX_JOBS = "RACON_TPU_SERVE_MAX_JOBS"
+ENV_GRACE = "RACON_TPU_SERVE_GRACE_S"
+
+PORT_FILE = "port"
+
+
+class PolishServer:
+    """Job table + engine session + per-scoring-key batchers. All HTTP
+    handlers and runner threads converge here; ``_lock`` guards the
+    table and batcher pool, never held across polishing work."""
+
+    def __init__(self, state_dir: str):
+        self.state_dir = state_dir
+        self.jobs_root = os.path.join(state_dir, "jobs")
+        os.makedirs(self.jobs_root, exist_ok=True)
+        self.session = EngineSession()
+        self._jobs: Dict[str, Job] = {}            # guarded-by: _lock
+        self._batchers: Dict[Tuple, CrossRequestBatcher] = {}  # guarded-by: _lock
+        self._threads: List[threading.Thread] = []  # guarded-by: _lock
+        self._n_done = 0                            # guarded-by: _lock
+        self._draining = False                      # guarded-by: _lock
+        self._lock = threading.Lock()
+        self._sem = threading.BoundedSemaphore(
+            max(1, int(envspec.read(ENV_MAX_JOBS))))
+        self._t0 = time.perf_counter()
+
+    # ------------------------------------------------------- lifecycle
+
+    def recover(self) -> int:
+        """Re-queue every journaled non-terminal job (daemon restart).
+        Terminal jobs rejoin the table read-only, their result streams
+        rebuilt from their stores so /stream keeps serving the exact
+        pre-restart bytes. Returns the number of jobs resumed."""
+        from racon_tpu.obs.metrics import record_serve_job
+        resumed = 0
+        for job in scan(self.jobs_root):
+            with self._lock:
+                self._jobs[job.id] = job
+            if job.state in TERMINAL:
+                job.finished.set()
+                if job.state == "done":
+                    rebuild_result(job)
+                continue
+            job.state = "queued"
+            job.persist()
+            record_serve_job("resumed", job.id, job.tenant)
+            resumed += 1
+            self._launch(job)
+        self._update_gauges()
+        return resumed
+
+    def drain(self, grace_s: Optional[float] = None) -> bool:
+        """Stop admitting, let in-flight jobs finish within the grace
+        window, then stop the batchers. Returns True when every runner
+        exited in time (the clean-SIGTERM contract)."""
+        grace = float(envspec.read(ENV_GRACE)) if grace_s is None \
+            else float(grace_s)
+        with self._lock:
+            self._draining = True
+            threads = list(self._threads)
+            batchers = list(self._batchers.values())
+        deadline = time.perf_counter() + grace
+        clean = True
+        for t in threads:
+            t.join(max(0.0, deadline - time.perf_counter()))
+            clean = clean and not t.is_alive()
+        for b in batchers:
+            b.close()
+        return clean
+
+    # ---------------------------------------------------------- job API
+
+    def submit(self, tenant: str, spec: JobSpec) -> Job:
+        from racon_tpu.obs.metrics import record_serve_job
+        from racon_tpu.resilience.faults import maybe_fault
+        maybe_fault("serve/submit")
+        with self._lock:
+            if self._draining:
+                raise RuntimeError(
+                    "[racon_tpu::serve] daemon is draining; "
+                    "not accepting jobs")
+            job_id = allocate_id(self.jobs_root)
+            directory = os.path.join(self.jobs_root, job_id)
+            os.makedirs(directory, exist_ok=True)
+            job = Job(job_id, str(tenant), spec, directory)
+            self._jobs[job_id] = job
+        # Journaled BEFORE the submit response: a daemon killed right
+        # after replying still knows about the job on restart.
+        job.persist()
+        record_serve_job("submitted", job.id, job.tenant)
+        self._update_gauges()
+        self._launch(job)
+        return job
+
+    def cancel(self, job_id: str) -> Job:
+        job = self.get(job_id)
+        if job.state not in TERMINAL:
+            job.cancel.set()
+        return job
+
+    def get(self, job_id: str) -> Job:
+        with self._lock:
+            job = self._jobs.get(job_id)
+        if job is None:
+            raise KeyError(job_id)
+        return job
+
+    def describe(self) -> Dict[str, object]:
+        with self._lock:
+            jobs = sorted(self._jobs.values(), key=lambda j: j.id)
+            draining = self._draining
+        active = sum(1 for j in jobs if j.state not in TERMINAL)
+        return {"jobs": [j.status() for j in jobs], "active": active,
+                "draining": draining}
+
+    # ----------------------------------------------------------- runner
+
+    def _launch(self, job: Job) -> None:
+        t = threading.Thread(target=self._run_job, args=(job,),
+                             name=f"serve-{job.id}", daemon=True)
+        with self._lock:
+            self._threads.append(t)
+        t.start()
+
+    def _batcher_for(self, spec: JobSpec) -> CrossRequestBatcher:
+        # One batcher per scoring key: windows only ever share a
+        # dispatch with windows the SAME compiled executables serve.
+        key = (spec.match, spec.mismatch, spec.gap, spec.backend,
+               spec.threads)
+        with self._lock:
+            b = self._batchers.get(key)
+            if b is None:
+                engine = self.session.engine_for(spec)
+                b = self._batchers[key] = \
+                    CrossRequestBatcher(engine).start()
+            return b
+
+    def _run_job(self, job: Job) -> None:
+        from racon_tpu.resilience.faults import maybe_fault
+        with self._sem:
+            if job.cancel.is_set():
+                self._finish(job, "cancelled", None)
+                return
+            job.state = "running"
+            job.persist()
+            try:
+                store = open_store(job)
+            except Exception as exc:
+                self._finish(job, "failed", str(exc))
+                return
+            job.n_committed = len(store.committed)
+            proxy = BatchedEngineProxy(self._batcher_for(job.spec),
+                                       job.id, job.tenant)
+
+            def before_commit(tid, rec):
+                if job.cancel.is_set():
+                    raise JobCancelled(job.id)
+                maybe_fault("serve/commit")
+
+            def after_commit(tid, rec):
+                job.n_committed += 1
+
+            def make_polisher():
+                return build_polisher(job.spec, engine=proxy)
+
+            state, error = "done", None
+            try:
+                polish_job(
+                    make_polisher,
+                    drop_unpolished=not job.spec.include_unpolished,
+                    store=store, emit=job.emit, fill_drops=True,
+                    hooks=JobHooks(before_commit=before_commit,
+                                   after_commit=after_commit))
+            except JobCancelled:
+                state = "cancelled"
+            except Exception as exc:
+                state, error = "failed", str(exc)
+            finally:
+                job.n_committed = len(store.committed)
+                store.close()
+            self._finish(job, state, error)
+
+    def _finish(self, job: Job, state: str, error: Optional[str]) -> None:
+        from racon_tpu.obs.metrics import record_serve_job
+        job.state = state
+        job.error = error
+        job.persist()
+        if state == "done":
+            with self._lock:
+                self._n_done += 1
+        record_serve_job("completed" if state == "done" else state,
+                         job.id, job.tenant)
+        self._update_gauges()
+        # Last: anyone woken by the event sees the journal, metrics,
+        # and gauges already final.
+        job.finished.set()
+
+    def _update_gauges(self) -> None:
+        from racon_tpu.obs.metrics import set_serve_active, set_serve_rate
+        with self._lock:
+            active = sum(1 for j in self._jobs.values()
+                         if j.state not in TERMINAL)
+            n_done = self._n_done
+        set_serve_active(active)
+        minutes = max((time.perf_counter() - self._t0) / 60.0, 1e-9)
+        set_serve_rate(n_done / minutes)
+
+
+# --------------------------------------------------------------- HTTP
+
+def serve_http(server: PolishServer, host: str, port: int):
+    """Bind the daemon's HTTP front end (daemon thread). Returns the
+    stdlib server; its ``server_address`` carries the bound port."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from racon_tpu.obs.export import CONTENT_TYPE, render_registry
+    from racon_tpu.obs.metrics import registry
+    from racon_tpu.resilience.watchdog import health_snapshot
+
+    class Handler(BaseHTTPRequestHandler):
+        def _reply(self, code: int, body: bytes,
+                   ctype: str = "application/json",
+                   headers: Optional[List[Tuple[str, str]]] = None
+                   ) -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in headers or []:
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _json(self, code: int, obj) -> None:
+            self._reply(code, (json.dumps(obj, sort_keys=True) +
+                               "\n").encode())
+
+        def do_GET(self):  # noqa: N802 (stdlib naming)
+            try:
+                self._get()
+            except KeyError:
+                self._json(404, {"error": "no such job"})
+            except Exception as exc:  # handler must not kill the daemon
+                self._json(500, {"error": str(exc)})
+
+        def _get(self) -> None:
+            path = self.path.rstrip("/")
+            if path == "/healthz":
+                snap = dict(health_snapshot())
+                snap["serve"] = server.describe()
+                self._json(200 if snap.get("status") == "ok" else 503,
+                           snap)
+            elif path == "/v1/jobs":
+                self._json(200, server.describe())
+            elif path.startswith("/v1/jobs/") and \
+                    path.endswith("/stream"):
+                job = server.get(path.split("/")[3])
+                self._reply(200, job.result_bytes(),
+                            ctype="application/octet-stream",
+                            headers=[("X-Racon-State", job.state)])
+            elif path.startswith("/v1/jobs/"):
+                self._json(200, server.get(path.split("/")[3]).status())
+            else:
+                self._reply(200, render_registry(
+                    registry().snapshot()).encode(), ctype=CONTENT_TYPE)
+
+        def do_POST(self):  # noqa: N802 (stdlib naming)
+            try:
+                self._post()
+            except KeyError:
+                self._json(404, {"error": "no such job"})
+            except (ValueError, RuntimeError) as exc:
+                self._json(400, {"error": str(exc)})
+            except Exception as exc:  # handler must not kill the daemon
+                self._json(500, {"error": str(exc)})
+
+        def _post(self) -> None:
+            path = self.path.rstrip("/")
+            if path == "/v1/jobs":
+                length = int(self.headers.get("Content-Length", "0"))
+                req = json.loads(self.rfile.read(length) or b"{}")
+                spec = JobSpec(str(req["sequences"]),
+                               str(req["overlaps"]),
+                               str(req["targets"]),
+                               **req.get("options", {}))
+                job = server.submit(req.get("tenant", "default"), spec)
+                self._json(202, {"id": job.id, "state": job.state})
+            elif path.startswith("/v1/jobs/") and \
+                    path.endswith("/cancel"):
+                job = server.cancel(path.split("/")[3])
+                self._json(200, job.status())
+            else:
+                self._json(404, {"error": "unknown endpoint"})
+
+        def log_message(self, *args):  # silence per-request stderr
+            pass
+
+    httpd = ThreadingHTTPServer((host, int(port)), Handler)
+    thread = threading.Thread(target=httpd.serve_forever,
+                              name="serve-http", daemon=True)
+    thread.start()
+    return httpd
+
+
+# --------------------------------------------------------------- entry
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    import signal
+
+    parser = argparse.ArgumentParser(
+        prog="python -m racon_tpu.server",
+        description="racon_tpu resident polishing daemon")
+    parser.add_argument("--state-dir", required=True,
+                        help="job journal + checkpoint root")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0,
+                        help="HTTP port (0 = ephemeral; the bound port "
+                             "is published to <state-dir>/port)")
+    args = parser.parse_args(argv)
+
+    from racon_tpu.obs.metrics import registry
+    from racon_tpu.obs.trace import configure as configure_trace
+    from racon_tpu.pipeline import configure as configure_pipeline
+    tracer = configure_trace()
+    # Jobs share the chip through the batcher, not through in-job
+    # pipeline stages — the dispatcher must stay the only device owner.
+    configure_pipeline(0)
+
+    server = PolishServer(args.state_dir)
+    server.session.activate()
+    resumed = server.recover()
+    if resumed:
+        print(f"[racon_tpu::serve] resumed {resumed} in-flight "
+              f"job(s)", file=sys.stderr)
+
+    try:
+        httpd = serve_http(server, args.host, args.port)
+    except OSError as exc:
+        print(f"[racon_tpu::serve] cannot bind {args.host}:{args.port}"
+              f": {exc}", file=sys.stderr)
+        return 1
+    port = httpd.server_address[1]
+    atomic_write_text(os.path.join(args.state_dir, PORT_FILE),
+                      f"{port}\n")
+    print(f"[racon_tpu::serve] listening on {args.host}:{port} "
+          f"(state: {args.state_dir})", file=sys.stderr)
+
+    stop = threading.Event()
+    signum_seen = {"n": signal.SIGTERM}
+
+    def _on_signal(signum, frame):
+        signum_seen["n"] = signum
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+    stop.wait()
+
+    print("[racon_tpu::serve] draining...", file=sys.stderr)
+    httpd.shutdown()
+    clean = server.drain()
+    tracer.finish(metrics=registry().snapshot())
+    if not clean:
+        print("[racon_tpu::serve] drain grace expired with jobs "
+              "still running", file=sys.stderr)
+        return 1
+    print("[racon_tpu::serve] drained clean", file=sys.stderr)
+    return 0

@@ -1,0 +1,309 @@
+"""The embeddable polishing engine: one library, thin frontends.
+
+Before this module, three callers each hand-rolled the same sequence —
+build a Polisher from option values, initialize, skip committed
+targets, drive ``Polisher.polish_records`` (polisher.py:396), interleave
+checkpoint re-emission with fresh records, commit each record durably:
+the serial CLI (cli.py), the distributed ledger worker
+(distributed/worker.py), and now the resident daemon (server/daemon.py).
+The loop is subtle enough that the copies had already grown distinct
+bug surfaces (stored-blob interleaving existed only in the CLI, the
+zero-window fill-drop pass only in the worker). This module is the one
+implementation; frontends differ only in the hooks they install.
+
+Identity is the other deduplicated concern: :meth:`JobSpec.identity`
+is the SINGLE source of the output-affecting config dict that feeds
+``run_fingerprint`` — the CLI's checkpoint store, the ledger, and the
+daemon's job journal all fingerprint through it, so a daemon job and a
+solo CLI run of the same inputs agree byte-for-byte on what "the same
+run" means.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from racon_tpu import __version__
+
+
+class JobSpec:
+    """Everything that defines one polishing job: the three input paths
+    plus every output-affecting option, with the CLI's defaults.
+
+    Execution knobs (backend, threads, mesh, pipeline) are deliberately
+    NOT identity: the execution paths are bit-identical by design, so
+    two runs differing only in how they execute share a fingerprint —
+    exactly the contract cli.py's ``ckpt_config`` established.
+    """
+
+    __slots__ = ("sequences", "overlaps", "targets", "include_unpolished",
+                 "fragment_correction", "window_length",
+                 "quality_threshold", "error_threshold", "match",
+                 "mismatch", "gap", "backend", "threads")
+
+    def __init__(self, sequences: str, overlaps: str, targets: str, *,
+                 include_unpolished: bool = False,
+                 fragment_correction: bool = False,
+                 window_length: int = 500,
+                 quality_threshold: float = 10.0,
+                 error_threshold: float = 0.3, match: int = 5,
+                 mismatch: int = -4, gap: int = -8,
+                 backend: str = "auto", threads: int = 1):
+        self.sequences = sequences
+        self.overlaps = overlaps
+        self.targets = targets
+        self.include_unpolished = bool(include_unpolished)
+        self.fragment_correction = bool(fragment_correction)
+        self.window_length = int(window_length)
+        self.quality_threshold = float(quality_threshold)
+        self.error_threshold = float(error_threshold)
+        self.match = int(match)
+        self.mismatch = int(mismatch)
+        self.gap = int(gap)
+        self.backend = backend
+        self.threads = int(threads)
+
+    @property
+    def paths(self) -> List[str]:
+        return [self.sequences, self.overlaps, self.targets]
+
+    def identity(self) -> Dict[str, object]:
+        """The output-affecting config dict — key-for-key the dict
+        cli.py fed ``run_fingerprint`` since PR 4, so fingerprints are
+        stable across the extraction."""
+        return {
+            "version": __version__,
+            "include_unpolished": self.include_unpolished,
+            "fragment_correction": self.fragment_correction,
+            "window_length": self.window_length,
+            "quality_threshold": self.quality_threshold,
+            "error_threshold": self.error_threshold,
+            "match": self.match,
+            "mismatch": self.mismatch,
+            "gap": self.gap,
+        }
+
+    def fingerprint(self) -> str:
+        from racon_tpu.resilience.checkpoint import run_fingerprint
+        return run_fingerprint(self.identity(), self.paths)
+
+    # ------------------------------------------------------- serialization
+
+    def as_dict(self) -> Dict[str, object]:
+        """JSON-safe form for the daemon's job journal."""
+        d = {"sequences": self.sequences, "overlaps": self.overlaps,
+             "targets": self.targets}
+        d.update({k: getattr(self, k) for k in self.__slots__[3:]})
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, object]) -> "JobSpec":
+        kwargs = {k: d[k] for k in cls.__slots__[3:] if k in d}
+        return cls(str(d["sequences"]), str(d["overlaps"]),
+                   str(d["targets"]), **kwargs)
+
+
+def build_polisher(spec: JobSpec, logger=None, mesh=None, engine=None):
+    """Construct an (uninitialized) Polisher from a :class:`JobSpec`.
+
+    ``engine``: optionally substitute a shared warm :class:`PoaEngine`
+    (or the daemon's batching proxy) for the one the Polisher would
+    build — the resident-process path, where compiled executables are
+    owned by the session, not the job.
+    """
+    from racon_tpu.models.polisher import PolisherType, create_polisher
+    polisher = create_polisher(
+        spec.sequences, spec.overlaps, spec.targets,
+        PolisherType.kF if spec.fragment_correction else PolisherType.kC,
+        spec.window_length, spec.quality_threshold, spec.error_threshold,
+        spec.match, spec.mismatch, spec.gap, backend=spec.backend,
+        logger=logger, threads=spec.threads, mesh=mesh)
+    if engine is not None:
+        polisher.engine = engine
+    return polisher
+
+
+class JobHooks:
+    """Per-record side-effect hooks threaded through :func:`polish_job`.
+
+    The no-op defaults serve the serial CLI and the daemon; the
+    distributed worker installs lease renewal, fault drills, and the
+    dynamic shard-shrink (split) protocol through them:
+
+    - ``range_end(default)`` — the loop's CURRENT exclusive end; the
+      worker returns ``claim.info.end``, which shrinks when a split
+      donates the tail mid-run.
+    - ``before_build(first_tid)`` — fires with the first uncommitted
+      tid just before the Polisher is constructed (the worker's
+      claim-time split evaluation, BEFORE any windows are built).
+    - ``on_resume(n_committed, n_windows_skipped)`` — after committed
+      targets were pruned (the CLI's resume stderr line).
+    - ``before_commit(tid, rec)`` — before the record is emitted and
+      committed (worker: fault site, lease renewal, obs flush; daemon:
+      cancellation check + ``serve/commit`` fault site).
+    - ``after_commit(tid, rec)`` — after the durable commit (worker:
+      dist accounting + post-commit split evaluation).
+    - ``before_fill(tid)`` — before each zero-window fill-drop commit
+      (worker: lease renewal).
+    """
+
+    def __init__(self, *, range_end: Optional[Callable] = None,
+                 before_build: Optional[Callable] = None,
+                 on_resume: Optional[Callable] = None,
+                 before_commit: Optional[Callable] = None,
+                 after_commit: Optional[Callable] = None,
+                 before_fill: Optional[Callable] = None):
+        self.range_end = range_end or (lambda default: default)
+        self.before_build = before_build or (lambda first_tid: None)
+        self.on_resume = on_resume or (lambda n_committed, n_skip: None)
+        self.before_commit = before_commit or (lambda tid, rec: None)
+        self.after_commit = after_commit or (lambda tid, rec: None)
+        self.before_fill = before_fill or (lambda tid: None)
+
+
+def polish_job(make_polisher: Callable, *, drop_unpolished: bool = True,
+               store=None, tid_range: Optional[Tuple[int, int]] = None,
+               n_targets: Optional[int] = None,
+               emit: Optional[Callable[[bytes], None]] = None,
+               fill_drops: bool = False,
+               hooks: Optional[JobHooks] = None) -> int:
+    """The one polish/commit/emit loop. Returns the number of targets
+    in the job's final effective range.
+
+    - ``store``: optional CheckpointStore; committed targets are
+      pruned from compute and (when ``emit`` is set) re-emitted
+      byte-identically from the shard, interleaved in input order with
+      freshly polished records.
+    - ``tid_range``: restrict to ``[start, end)`` target ids (the
+      distributed shard path); None polishes everything.
+    - ``n_targets``: total targets when the caller already knows it
+      (skips nothing — it only avoids needing the Polisher when every
+      tid in range is committed). With ``tid_range=None`` and
+      ``n_targets=None`` the Polisher is always built and its parsed
+      target count is used.
+    - ``emit``: byte sink for the FASTA stream (stdout for the CLI, the
+      job's result buffer for the daemon; the ledger worker passes
+      None — its merge phase emits).
+    - ``fill_drops``: commit targets that never reach the assembler
+      (zero windows) as drops, so "every tid committed" is the
+      completion invariant (the worker/daemon contract; the CLI keeps
+      its historical manifests, which omit them).
+    """
+    from racon_tpu.obs.metrics import record_ckpt
+
+    hooks = hooks if hooks is not None else JobHooks()
+    committed = store.committed if store is not None else {}
+    if tid_range is not None:
+        start, end = int(tid_range[0]), int(tid_range[1])
+    else:
+        start, end = 0, n_targets
+
+    next_tid = start
+
+    def emit_stored(limit: int) -> None:
+        # Re-emit committed contigs (exact shard bytes) for every
+        # target slot before `limit` — interleaving stored and freshly
+        # polished targets in input order keeps resumed output
+        # byte-identical to an uninterrupted run's.
+        nonlocal next_tid
+        while next_tid < limit:
+            if emit is not None and store is not None \
+                    and next_tid in committed:
+                blob = store.read_emitted(next_tid)
+                if blob is not None:
+                    emit(blob)
+                record_ckpt("skip", next_tid,
+                            len(blob) if blob else 0)
+            next_tid += 1
+
+    build = end is None or any(tid not in committed
+                               for tid in range(start, end))
+    if build:
+        first = start
+        while first in committed:
+            first += 1
+        hooks.before_build(first)
+        polisher = make_polisher()
+        polisher.initialize()
+        if end is None:
+            end = polisher._targets_size
+        if tid_range is not None:
+            polisher.restrict_targets(range(start, end))
+        n_skip = polisher.skip_targets(committed) if committed else 0
+        hooks.on_resume(len(committed), n_skip)
+        # Each contig is handled the moment its last window retires,
+        # then durably committed before the next one.
+        for tid, rec in polisher.polish_records(drop_unpolished):
+            if tid >= hooks.range_end(end):
+                break  # range shrank under us (shard split donation)
+            hooks.before_commit(tid, rec)
+            emit_stored(tid)
+            if emit is not None and rec is not None:
+                emit(b">" + rec.name.encode() + b"\n" + rec.data +
+                     b"\n")
+            if store is not None:
+                if rec is not None:
+                    store.commit(tid, rec.name.encode(), rec.data)
+                else:
+                    store.commit_dropped(tid)
+            hooks.after_commit(tid, rec)
+            next_tid = tid + 1
+    else:
+        hooks.on_resume(len(committed), 0)
+
+    end = hooks.range_end(end)
+    if fill_drops and store is not None:
+        # Targets with zero windows never reach the assembler, so they
+        # yield nothing above — commit them as drops explicitly so the
+        # done marker really means "every tid in range accounted for".
+        for tid in range(start, end):
+            if tid not in committed:
+                hooks.before_fill(tid)
+                store.commit_dropped(tid)
+    emit_stored(end)
+    return end - start
+
+
+class EngineSession:
+    """Explicit ownership of a resident process's warm state: the jax
+    compile cache and a pool of :class:`PoaEngine` instances keyed by
+    scoring parameters, shared across jobs so every job with the same
+    scores reuses the same compiled executables (warm start is the
+    whole point of the daemon — PROFILE.md's 44.5 s → 12.1 s jaxcache
+    row becomes ~0 s for every job after the first per shape bucket).
+
+    Window consensus is per-window deterministic and independent of
+    batch composition (the serial-vs-streaming bit-identity invariant,
+    differentially tested since PR 3), so sharing one engine — and
+    mixing jobs' windows in its batches — cannot change any job's
+    bytes.
+    """
+
+    def __init__(self):
+        self._engines: Dict[tuple, object] = {}  # guarded-by: _lock
+        self._lock = threading.Lock()
+        self._activated = False  # guarded-by: _lock
+
+    def activate(self) -> None:
+        """Idempotently arm the persistent compile cache."""
+        with self._lock:
+            if self._activated:
+                return
+            self._activated = True
+        from racon_tpu.utils.jaxcache import enable_compile_cache
+        enable_compile_cache()
+
+    def engine_for(self, spec: JobSpec, mesh=None):
+        """The session's shared engine for this spec's scoring tuple."""
+        from racon_tpu.ops.poa import PoaEngine
+        key = (spec.match, spec.mismatch, spec.gap, spec.backend,
+               spec.threads)
+        with self._lock:
+            eng = self._engines.get(key)
+            if eng is None:
+                eng = PoaEngine(spec.match, spec.mismatch, spec.gap,
+                                backend=spec.backend,
+                                threads=spec.threads, mesh=mesh)
+                self._engines[key] = eng
+            return eng

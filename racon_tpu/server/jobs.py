@@ -1,0 +1,169 @@
+"""Journaled job lifecycle for the resident daemon.
+
+Every submitted job owns one directory under ``<state-dir>/jobs/``:
+
+- ``job.json`` — the journal record (schema, id, tenant, the full
+  :class:`~racon_tpu.server.engine.JobSpec`, current state, error),
+  rewritten atomically at every state transition;
+- ``ckpt/``    — a standard checkpoint-ledger store
+  (resilience/checkpoint.py) holding every durably committed contig.
+
+Together they make the daemon restartable by construction: after a
+SIGKILL the journal says which jobs were in flight, and re-running each
+through the engine's ``polish_job`` loop against its resumed store
+re-emits the committed prefix byte-identically and polishes only the
+remainder — the same resume contract the CLI and the distributed
+worker already honor, reused rather than reinvented.
+
+Job ids are sequential (``j0001``, ``j0002``, ...), allocated as
+max-existing + 1 so a restarted daemon never reuses or reorders ids —
+no clocks, no randomness, nothing to collide after recovery.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Dict, List, Optional
+
+from racon_tpu.server.engine import JobSpec
+from racon_tpu.utils.atomicio import atomic_write_text
+
+SCHEMA = 1
+JOB_FILE = "job.json"
+CKPT_DIR = "ckpt"
+
+#: Lifecycle: queued -> running -> done | failed | cancelled.
+STATES = ("queued", "running", "done", "failed", "cancelled")
+TERMINAL = ("done", "failed", "cancelled")
+
+
+class JobCancelled(Exception):
+    """Raised inside a job's polish loop when its cancel flag is set."""
+
+
+class Job:
+    """One submitted polishing job: journal record + in-memory result
+    stream. The runner thread is the only writer of ``chunks`` (list
+    appends are atomic), so HTTP streamers snapshot it lock-free."""
+
+    __slots__ = ("id", "tenant", "spec", "directory", "state", "error",
+                 "chunks", "cancel", "finished", "n_committed")
+
+    def __init__(self, job_id: str, tenant: str, spec: JobSpec,
+                 directory: str, state: str = "queued",
+                 error: Optional[str] = None):
+        self.id = job_id
+        self.tenant = tenant
+        self.spec = spec
+        self.directory = directory
+        self.state = state
+        self.error = error
+        self.chunks: List[bytes] = []
+        self.cancel = threading.Event()
+        self.finished = threading.Event()
+        self.n_committed = 0
+
+    @property
+    def ckpt_dir(self) -> str:
+        return os.path.join(self.directory, CKPT_DIR)
+
+    # ------------------------------------------------------- results
+
+    def emit(self, blob: bytes) -> None:
+        """The ``polish_job`` byte sink — committed-prefix re-emission
+        and fresh records arrive here in target order."""
+        self.chunks.append(blob)
+
+    def result_bytes(self) -> bytes:
+        return b"".join(list(self.chunks))
+
+    # ------------------------------------------------------- journal
+
+    def persist(self) -> None:
+        """Atomically rewrite the journal record (state transition)."""
+        record = {"schema": SCHEMA, "id": self.id,
+                  "tenant": self.tenant, "state": self.state,
+                  "error": self.error, "spec": self.spec.as_dict()}
+        atomic_write_text(os.path.join(self.directory, JOB_FILE),
+                          json.dumps(record, sort_keys=True) + "\n")
+
+    @classmethod
+    def load(cls, directory: str) -> "Job":
+        with open(os.path.join(directory, JOB_FILE), "r",
+                  encoding="utf-8") as fh:
+            record = json.load(fh)
+        if record.get("schema") != SCHEMA:
+            raise ValueError(
+                f"[racon_tpu::serve] {directory}: unknown job journal "
+                f"schema {record.get('schema')!r}")
+        return cls(str(record["id"]), str(record["tenant"]),
+                   JobSpec.from_dict(record["spec"]), directory,
+                   state=str(record["state"]),
+                   error=record.get("error"))
+
+    def status(self) -> Dict[str, object]:
+        """JSON-ready view for the HTTP status endpoints."""
+        return {"id": self.id, "tenant": self.tenant,
+                "state": self.state, "error": self.error,
+                "committed": self.n_committed,
+                "bytes": sum(len(c) for c in list(self.chunks))}
+
+
+# ------------------------------------------------------------ directory
+
+def allocate_id(jobs_root: str) -> str:
+    """Next sequential job id under ``jobs_root`` (caller holds the
+    server's submit lock)."""
+    seq = 0
+    if os.path.isdir(jobs_root):
+        for name in os.listdir(jobs_root):
+            if name.startswith("j") and name[1:].isdigit():
+                seq = max(seq, int(name[1:]))
+    return f"j{seq + 1:04d}"
+
+
+def scan(jobs_root: str) -> List[Job]:
+    """Load every journaled job, oldest first (restart recovery)."""
+    out: List[Job] = []
+    if not os.path.isdir(jobs_root):
+        return out
+    for name in sorted(os.listdir(jobs_root)):
+        directory = os.path.join(jobs_root, name)
+        if os.path.isfile(os.path.join(directory, JOB_FILE)):
+            out.append(Job.load(directory))
+    return out
+
+
+def open_store(job: Job):
+    """The job's checkpoint store: resumed when its meta exists (daemon
+    restart), created fresh otherwise. Identity runs through
+    JobSpec.fingerprint(), so a tampered input or edited spec refuses
+    to resume instead of silently mixing outputs."""
+    from racon_tpu.resilience.checkpoint import CheckpointStore
+    fingerprint = job.spec.fingerprint()
+    probe = CheckpointStore(job.ckpt_dir, fingerprint)
+    if os.path.isfile(probe.meta_path):
+        return CheckpointStore.resume(job.ckpt_dir, fingerprint)
+    return CheckpointStore.create(job.ckpt_dir, fingerprint)
+
+
+def rebuild_result(job: Job) -> None:
+    """Reload a terminal job's emitted bytes from its store (restart
+    made the in-memory stream empty). Committed shard slices are the
+    exact originally emitted bytes, so the rebuilt stream is identical
+    to what the pre-restart daemon served."""
+    from racon_tpu.resilience.checkpoint import CheckpointStore
+    store = CheckpointStore.resume(job.ckpt_dir,
+                                   job.spec.fingerprint())
+    try:
+        chunks: List[bytes] = []
+        for tid in sorted(store.committed):
+            blob = store.read_emitted(tid)
+            if blob is not None:
+                chunks.append(blob)
+        job.chunks = chunks
+        job.n_committed = len(store.committed)
+    finally:
+        store.close()

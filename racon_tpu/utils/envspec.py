@@ -175,6 +175,18 @@ declare("RACON_TPU_STRAGGLER_FRAC", "", "float", "RESILIENCE.md",
 declare("RACON_TPU_WATCHDOG_TERMINAL", "", "spec", "RESILIENCE.md",
         "terminal-breach limit (count or count/window_s)")
 
+# docs/SERVER.md — resident daemon and cross-request batcher
+declare("RACON_TPU_SERVE_BATCH", "256", "int", "SERVER.md",
+        "cross-request batch capacity in windows per dispatch")
+declare("RACON_TPU_SERVE_BATCH_WAIT_S", "0.05", "float", "SERVER.md",
+        "max staging wait before a partial batch dispatches")
+declare("RACON_TPU_SERVE_GRACE_S", "30", "float", "SERVER.md",
+        "SIGTERM drain grace: seconds to finish in-flight jobs")
+declare("RACON_TPU_SERVE_MAX_JOBS", "4", "int", "SERVER.md",
+        "max concurrently running jobs (admission semaphore)")
+declare("RACON_TPU_SERVE_QUEUE", "64", "int", "SERVER.md",
+        "bounded admission queue depth in work items")
+
 # docs/SCHEDULER.md — shape-bucket scheduler
 declare("RACON_TPU_ADAPTIVE", "", "flag", "SCHEDULER.md",
         "adaptive early-exit rounds (converged chunks stop early)")

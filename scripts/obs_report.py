@@ -63,6 +63,11 @@ KIND_REQUIRED_ATTRS = {
     # walk stage over ops/colwalk.py::dispatch_walk): geometry of the
     # chunk whose traceback it finishes.
     "walk": ("lanes", "windows"),
+    # One serve-plane event (racon_tpu/server/, obs/metrics.py): a job
+    # lifecycle transition (submitted/resumed/completed/...) or a
+    # cross-request batch dispatch; job/tenant are comma-joined lists
+    # on batch points so one dispatch names every rider.
+    "serve": ("job", "tenant"),
 }
 
 # Span kinds that carry no required attributes — structural intervals
@@ -250,6 +255,7 @@ def render(tr: Dict[str, object], out=None,
     _render_pipeline(m, out)
     _render_resilience(m, by_kind, out)
     _render_dist(m, by_kind, out)
+    _render_server(m, by_kind, out)
     if fleet_dir:
         _render_fleet(fleet_dir, out)
     _render_redo(m, out)
@@ -431,6 +437,45 @@ def _render_dist(m, by_kind, out) -> None:
         workers = ", ".join(f"{w}: {n}" for w, n in
                             sorted(by_worker.items()))
         print(f"  events by worker: {workers}", file=out)
+
+
+def _render_server(m, by_kind, out) -> None:
+    """The "server:" section: daemon job lifecycle totals, the
+    cross-request batcher's packing efficiency, and per-tenant event
+    counts, from the ``serve_*`` metrics and ``serve`` points the
+    server plane records (docs/SERVER.md). Runs that never served
+    (no serve_* activity) print nothing."""
+    m = m or {}
+    serve = {k: v for k, v in m.items() if k.startswith("serve_")}
+    spans = by_kind.get("serve", [])
+    if not serve and not spans:
+        return
+    print(f"\nserver: submitted={int(m.get('serve_jobs_submitted', 0))}"
+          f"  completed={int(m.get('serve_jobs_completed', 0))}  "
+          f"failed={int(m.get('serve_jobs_failed', 0))}  "
+          f"cancelled={int(m.get('serve_jobs_cancelled', 0))}  "
+          f"resumed={int(m.get('serve_jobs_resumed', 0))}", file=out)
+    batches = int(m.get("serve_batches", 0) or 0)
+    if batches:
+        print(f"  batches={batches}  "
+              f"windows={int(m.get('serve_batch_windows', 0))}  "
+              f"occupancy={float(m.get('serve_batch_occupancy', 0)):.4f}"
+              f"  queue_peak={int(m.get('serve_queue_depth_peak', 0))}  "
+              f"tenant_wait={float(m.get('serve_tenant_wait_s', 0)):.3f}"
+              f"s", file=out)
+    rate = m.get("serve_jobs_per_min")
+    if rate is not None:
+        print(f"  throughput: {float(rate):.4f} job(s)/min", file=out)
+    if spans:
+        # Batch points carry comma-joined tenant lists; split them so a
+        # tenant's count includes every dispatch it rode in.
+        by_tenant: Dict[str, int] = {}
+        for s in spans:
+            for tenant in str(s.get("tenant", "?")).split(","):
+                by_tenant[tenant] = by_tenant.get(tenant, 0) + 1
+        tenants = ", ".join(f"{t}: {n}" for t, n in
+                            sorted(by_tenant.items()))
+        print(f"  events by tenant: {tenants}", file=out)
 
 
 def _render_fleet(fleet_dir: str, out) -> None:

@@ -1,0 +1,255 @@
+"""CI smoke: polishing-as-a-service, end to end through a real daemon.
+
+1. **Byte-identity**: every job served by the daemon — solo or packed
+   into cross-request batches with other tenants' jobs — streams FASTA
+   byte-identical to a solo serial CLI run of the same inputs.
+2. **Cross-request occupancy**: three concurrent jobs from two tenants
+   share dispatches, so mean batch occupancy strictly exceeds the
+   one-job-at-a-time occupancy of the same workload.
+3. **Clean drain**: SIGTERM lets in-flight jobs finish and exits 0.
+4. **Kill-and-restart**: a daemon hard-killed mid-job
+   (``serve/commit:1!kill`` → ``os._exit(137)``) restarts, re-queues
+   the journaled job, re-emits the committed prefix from its store, and
+   finishes byte-identical.
+
+Subprocesses (not in-process PolishServer) so the kill is a real hard
+exit and each daemon's env-gated knobs arm independently.
+"""
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np                                   # noqa: E402
+
+BASES = np.frombuffer(b"ACGT", np.uint8)
+BOOT = "import sys; from racon_tpu import cli; sys.exit(cli.main(sys.argv[1:]))"
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _noisy(rng, truth):
+    out = []
+    for b in truth:
+        r = rng.random()
+        if r < 0.03:
+            continue
+        out.append(int(rng.integers(0, 4)) if r < 0.06 else int(
+            np.searchsorted(BASES, b)))
+    return bytes(BASES[np.array(out)])
+
+
+def _write_inputs(d, n_contigs=3, seed=11):
+    rng = np.random.default_rng(seed)
+    drafts, reads, paf = [], [], []
+    for c in range(n_contigs):
+        truth = BASES[rng.integers(0, 4, 300 + 40 * c)]
+        draft = _noisy(rng, truth)
+        drafts.append(b">c%d\n%s\n" % (c, draft))
+        for i in range(6):
+            r = _noisy(rng, truth)
+            rid = f"r{c}_{i}"
+            reads.append(b">%s\n%s\n" % (rid.encode(), r))
+            paf.append(f"{rid}\t{len(r)}\t0\t{len(r)}\t+\tc{c}"
+                       f"\t{len(draft)}\t0\t{len(draft)}"
+                       f"\t{min(len(r), len(draft))}"
+                       f"\t{max(len(r), len(draft))}\t60")
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "draft.fasta"), "wb") as fh:
+        fh.write(b"".join(drafts))
+    with open(os.path.join(d, "reads.fasta"), "wb") as fh:
+        fh.write(b"".join(reads))
+    with open(os.path.join(d, "ovl.paf"), "w") as fh:
+        fh.write("\n".join(paf) + "\n")
+
+
+def _solo_cli(d):
+    e = dict(os.environ)
+    e.pop("RACON_TPU_FAULTS", None)
+    e.pop("RACON_TPU_TRACE", None)
+    proc = subprocess.run(
+        [sys.executable, "-c", BOOT, "--backend", "jax",
+         os.path.join(d, "reads.fasta"), os.path.join(d, "ovl.paf"),
+         os.path.join(d, "draft.fasta")],
+        capture_output=True, env=e, cwd=ROOT)
+    assert proc.returncode == 0, proc.stderr.decode()
+    return proc.stdout
+
+
+# ------------------------------------------------------------ daemon ops
+
+
+def _start_daemon(state, env=None):
+    e = dict(os.environ)
+    e.pop("RACON_TPU_FAULTS", None)
+    e.pop("RACON_TPU_TRACE", None)
+    e.update(env or {})
+    os.makedirs(state, exist_ok=True)
+    port_file = os.path.join(state, "port")
+    if os.path.exists(port_file):
+        os.remove(port_file)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "racon_tpu.server", "--state-dir", state,
+         "--port", "0"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, env=e,
+        cwd=ROOT)
+    deadline = time.monotonic() + 180
+    while not os.path.exists(port_file):
+        if proc.poll() is not None:
+            raise AssertionError("daemon died on startup:\n" +
+                                 proc.stderr.read().decode())
+        if time.monotonic() > deadline:
+            proc.kill()
+            raise AssertionError("daemon never published its port")
+        time.sleep(0.05)
+    with open(port_file) as fh:
+        port = int(fh.read().strip())
+    return proc, port
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30) as resp:
+        return resp.read()
+
+
+def _submit(port, tenant, d):
+    body = json.dumps({
+        "tenant": tenant,
+        "sequences": os.path.join(d, "reads.fasta"),
+        "overlaps": os.path.join(d, "ovl.paf"),
+        "targets": os.path.join(d, "draft.fasta"),
+        "options": {"backend": "jax"}}).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/jobs", data=body, method="POST")
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return json.loads(resp.read())["id"]
+
+
+def _wait_done(port, job_id, timeout_s=300):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        status = json.loads(_get(port, f"/v1/jobs/{job_id}"))
+        if status["state"] in ("done", "failed", "cancelled"):
+            assert status["state"] == "done", status
+            return
+        time.sleep(0.2)
+    raise AssertionError(f"job {job_id} did not finish in {timeout_s}s")
+
+
+def _occupancy(port):
+    text = _get(port, "/metrics").decode()
+    m = re.search(r"^racon_tpu_serve_batch_occupancy (\S+)$", text,
+                  re.MULTILINE)
+    assert m, "serve_batch_occupancy not exported:\n" + text
+    return float(m.group(1))
+
+
+def _drain(proc):
+    proc.send_signal(signal.SIGTERM)
+    rc = proc.wait(timeout=120)
+    assert rc == 0, ("daemon drain not clean (rc {}):\n".format(rc) +
+                     proc.stderr.read().decode())
+
+
+def main():
+    with tempfile.TemporaryDirectory() as d:
+        dirs = [os.path.join(d, f"in{i}") for i in range(3)]
+        for i, di in enumerate(dirs):
+            _write_inputs(di, seed=11 + 11 * i)
+        bases = [_solo_cli(di) for di in dirs]
+        assert all(b.count(b">") == 3 for b in bases)
+        tenants = ["acme", "acme", "umbrella"]
+
+        # --- phase 1: one job at a time (the occupancy baseline).
+        proc, port = _start_daemon(os.path.join(d, "s1"),
+                                   env={"RACON_TPU_SERVE_BATCH": "16"})
+        jids = []
+        for tenant, di in zip(tenants, dirs):
+            jid = _submit(port, tenant, di)
+            _wait_done(port, jid)
+            jids.append(jid)
+        occ_solo = _occupancy(port)
+        for jid, base in zip(jids, bases):
+            assert _get(port, f"/v1/jobs/{jid}/stream") == base, \
+                f"solo-phase job {jid} differs from serial CLI"
+        _drain(proc)
+        print(f"[server-smoke] sequential: 3 jobs byte-identical, "
+              f"occupancy {occ_solo:.4f}, SIGTERM drain clean",
+              flush=True)
+
+        # --- phase 2: 3 concurrent jobs, 2 tenants, shared dispatches.
+        trace = os.path.join(d, "serve.jsonl")
+        proc, port = _start_daemon(os.path.join(d, "s2"), env={
+            "RACON_TPU_SERVE_BATCH": "16",
+            # Generous staging window so the jobs' chunks actually
+            # co-ride despite initialize() skew between them.
+            "RACON_TPU_SERVE_BATCH_WAIT_S": "15",
+            "RACON_TPU_TRACE": trace})
+        jids = [_submit(port, tenant, di)
+                for tenant, di in zip(tenants, dirs)]
+        for jid in jids:
+            _wait_done(port, jid)
+        occ_conc = _occupancy(port)
+        health = json.loads(_get(port, "/healthz"))
+        assert health["status"] == "ok", health
+        assert len(health["serve"]["jobs"]) == 3, health
+        for jid, base in zip(jids, bases):
+            assert _get(port, f"/v1/jobs/{jid}/stream") == base, \
+                f"concurrent job {jid} differs from serial CLI"
+        _drain(proc)
+        assert occ_conc > occ_solo, (
+            f"cross-request batching did not raise occupancy: "
+            f"concurrent {occ_conc:.4f} <= solo {occ_solo:.4f}")
+        # The daemon's trace must satisfy the serve span contract and
+        # the report must render its server section from it.
+        import io
+        from scripts import obs_report
+        tr = obs_report.load_trace(trace)
+        errs = obs_report.validate(tr)
+        assert not errs, "trace schema violations:\n" + "\n".join(errs)
+        kinds = {s["kind"] for s in tr["spans"].values()}
+        assert "serve" in kinds, kinds
+        buf = io.StringIO()
+        obs_report.render(tr, out=buf)
+        assert "server:" in buf.getvalue(), buf.getvalue()
+        print(f"[server-smoke] concurrent: 3 jobs / 2 tenants "
+              f"byte-identical, occupancy {occ_conc:.4f} > "
+              f"{occ_solo:.4f} (trace valid, report renders server "
+              f"section)", flush=True)
+
+        # --- phase 3: hard kill mid-job, restart, resume to identity.
+        state = os.path.join(d, "s3")
+        proc, port = _start_daemon(state, env={
+            "RACON_TPU_FAULTS": "serve/commit:1!kill"})
+        jid = _submit(port, "acme", dirs[0])
+        rc = proc.wait(timeout=300)
+        assert rc == 137, f"expected hard kill (137), got {rc}"
+        man = os.path.join(state, "jobs", jid, "ckpt", "manifest.jsonl")
+        committed = sum(1 for line in open(man)
+                        if json.loads(line).get("ev") == "contig")
+        assert committed == 1, f"expected 1 committed contig, {committed}"
+
+        proc, port = _start_daemon(state)
+        _wait_done(port, jid)
+        assert _get(port, f"/v1/jobs/{jid}/stream") == bases[0], \
+            "kill-and-restart stream differs from serial CLI"
+        metrics_text = _get(port, "/metrics").decode()
+        assert "racon_tpu_serve_jobs_resumed_total 1" in metrics_text
+        _drain(proc)
+        print("[server-smoke] kill-and-restart byte-identical "
+              f"({committed} contig from shard, 2 recomputed)",
+              flush=True)
+
+    print("[server-smoke] PASS", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,460 @@
+"""Server subsystem tests: the embeddable engine API, the
+cross-request batcher, the journaled daemon lifecycle, and restart
+recovery (racon_tpu/server/, docs/SERVER.md)."""
+
+import contextlib
+import io
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from racon_tpu.obs import metrics as obs_metrics
+from racon_tpu.resilience import faults
+from racon_tpu.server.batch import (BatchedEngineProxy,
+                                    CrossRequestBatcher, ServeError)
+from racon_tpu.server.engine import JobSpec
+from racon_tpu.server.jobs import Job, allocate_id, scan
+
+BASES = np.frombuffer(b"ACGT", np.uint8)
+
+
+@pytest.fixture(autouse=True)
+def server_sandbox(monkeypatch):
+    """Keep the process-global injector/registry out of other tests."""
+    monkeypatch.delenv(faults.ENV_FAULTS, raising=False)
+    faults.configure(None)
+    obs_metrics.reset()
+    yield
+    faults.configure(None)
+    obs_metrics.reset()
+
+
+# ---------------------------------------------------------------- JobSpec
+
+
+def test_jobspec_identity_and_roundtrip():
+    spec = JobSpec("r.fa", "o.paf", "d.fa", window_length=250,
+                   match=3, backend="jax")
+    ident = spec.identity()
+    # The identity dict is the checkpoint-fingerprint config: exactly
+    # the output-affecting keys, never execution knobs.
+    assert set(ident) == {"version", "include_unpolished",
+                          "fragment_correction", "window_length",
+                          "quality_threshold", "error_threshold",
+                          "match", "mismatch", "gap"}
+    assert "backend" not in ident and "threads" not in ident
+    clone = JobSpec.from_dict(spec.as_dict())
+    assert clone.identity() == ident
+    assert clone.paths == ["r.fa", "o.paf", "d.fa"]
+    assert clone.backend == "jax"
+
+
+# ---------------------------------------------------------------- batcher
+
+
+class _Window:
+    """Stand-in with the Window surface the batcher touches."""
+
+    def __init__(self, n=300, layers=3):
+        self._n = n
+        self.n_layers = layers
+        self.polished = False
+
+    def __len__(self):
+        return self._n
+
+
+class _FakeEngine:
+    backend = "fake"
+
+    def __init__(self, fail=False, delay_s=0.0):
+        self.batches = []
+        self.fail = fail
+        self.delay_s = delay_s
+
+    def consensus_windows(self, windows):
+        self.batches.append(len(windows))
+        if self.fail:
+            raise RuntimeError("device wedged")
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        for w in windows:
+            w.polished = True
+        return len(windows)
+
+
+def _concurrent_consensus(batcher, jobs):
+    """Run [(job_id, tenant, windows), ...] concurrently; returns
+    {job_id: result-or-exception}."""
+    results = {}
+
+    def run(jid, tenant, windows):
+        proxy = BatchedEngineProxy(batcher, jid, tenant)
+        try:
+            results[jid] = proxy.consensus_windows(windows)
+        except Exception as exc:  # collected for assertions
+            results[jid] = exc
+
+    threads = [threading.Thread(target=run, args=spec) for spec in jobs]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return results
+
+
+def test_batcher_packs_across_jobs():
+    """Three jobs' windows merge into one full-occupancy dispatch
+    instead of three partial ones."""
+    eng = _FakeEngine()
+    b = CrossRequestBatcher(eng, capacity=32, wait_s=1.0,
+                            queue_cap=8).start()
+    try:
+        results = _concurrent_consensus(b, [
+            ("j1", "acme", [_Window() for _ in range(5)]),
+            ("j2", "acme", [_Window() for _ in range(5)]),
+            ("j3", "umbrella", [_Window() for _ in range(5)]),
+        ])
+    finally:
+        b.close()
+    assert results == {"j1": 5, "j2": 5, "j3": 5}
+    assert sum(eng.batches) == 15
+    assert len(eng.batches) < 3, "cross-job packing never happened"
+    snap = obs_metrics.registry().snapshot()
+    assert snap["serve_batch_windows"] == 15
+    assert snap["serve_batch_occupancy"] > 0
+    assert snap["serve_batches"] == len(eng.batches)
+
+
+def test_batcher_splits_oversized_request():
+    """A request larger than capacity slices into capacity-sized items
+    — the chip never sees a super-sized batch."""
+    eng = _FakeEngine()
+    b = CrossRequestBatcher(eng, capacity=4, wait_s=0.01,
+                            queue_cap=8).start()
+    try:
+        results = _concurrent_consensus(
+            b, [("j1", "acme", [_Window() for _ in range(10)])])
+    finally:
+        b.close()
+    assert results == {"j1": 10}
+    assert max(eng.batches) <= 4
+
+
+def test_batcher_tenant_fairness():
+    """Round-robin compose: when one tenant floods staging, the other
+    tenant still lands in the very next batch."""
+    eng = _FakeEngine()
+    b = CrossRequestBatcher(eng, capacity=4, wait_s=60.0, queue_cap=64)
+    # Drive the dispatcher loop by hand: flood with acme, then one
+    # umbrella item; the first composed batch must carry both tenants.
+    from racon_tpu.server.batch import _WorkItem
+    for i in range(6):
+        b._stage(_WorkItem(f"a{i}", "acme", [_Window(), _Window()]))
+    b._stage(_WorkItem("u0", "umbrella", [_Window(), _Window()]))
+    batch = b._compose()
+    assert {it.tenant for it in batch} == {"acme", "umbrella"}
+
+
+def test_batcher_flush_deadline_dispatches_partial():
+    """A lone small request does not wait forever for peers: the
+    staging deadline flushes a partial batch."""
+    eng = _FakeEngine()
+    b = CrossRequestBatcher(eng, capacity=1024, wait_s=0.05,
+                            queue_cap=8).start()
+    try:
+        t0 = time.perf_counter()
+        results = _concurrent_consensus(
+            b, [("j1", "acme", [_Window() for _ in range(3)])])
+        elapsed = time.perf_counter() - t0
+    finally:
+        b.close()
+    assert results == {"j1": 3}
+    assert elapsed < 5.0
+
+
+def test_batcher_dispatch_failure_fans_out_to_jobs():
+    """A failed dispatch surfaces as ServeError on every job whose
+    windows rode the batch — no hangs, no silent loss."""
+    eng = _FakeEngine(fail=True)
+    b = CrossRequestBatcher(eng, capacity=32, wait_s=0.5,
+                            queue_cap=8).start()
+    try:
+        results = _concurrent_consensus(b, [
+            ("j1", "acme", [_Window() for _ in range(2)]),
+            ("j2", "umbrella", [_Window() for _ in range(2)]),
+        ])
+    finally:
+        b.close()
+    assert all(isinstance(v, ServeError) for v in results.values())
+
+
+def test_batcher_injected_dispatch_fault():
+    """The serve/dispatch fault site fires inside the dispatcher and
+    fans out as a typed error (the chaos-drill hook for the daemon)."""
+    faults.configure("serve/dispatch:0")
+    eng = _FakeEngine()
+    b = CrossRequestBatcher(eng, capacity=32, wait_s=0.5,
+                            queue_cap=8).start()
+    try:
+        results = _concurrent_consensus(
+            b, [("j1", "acme", [_Window() for _ in range(2)])])
+    finally:
+        b.close()
+    assert isinstance(results["j1"], ServeError)
+    snap = obs_metrics.registry().snapshot()
+    assert snap["res_fault_site_serve_dispatch"] == 1
+
+
+# ------------------------------------------------------------ job journal
+
+
+def test_job_journal_roundtrip_and_id_allocation(tmp_path):
+    root = str(tmp_path)
+    assert allocate_id(root) == "j0001"
+    spec = JobSpec("r.fa", "o.paf", "d.fa", window_length=123)
+    d = os.path.join(root, "j0001")
+    os.makedirs(d)
+    job = Job("j0001", "acme", spec, d)
+    job.persist()
+    # Ids never reuse: allocation is max-existing + 1.
+    assert allocate_id(root) == "j0002"
+    loaded = scan(root)
+    assert len(loaded) == 1
+    assert loaded[0].id == "j0001"
+    assert loaded[0].tenant == "acme"
+    assert loaded[0].state == "queued"
+    assert loaded[0].spec.identity() == spec.identity()
+    # State transitions rewrite the journal atomically.
+    job.state = "done"
+    job.persist()
+    assert scan(root)[0].state == "done"
+
+
+# ---------------------------------------------------- daemon (in-process)
+
+
+def _mutate(rng, truth):
+    out = []
+    for b in truth:
+        r = rng.random()
+        if r < 0.03:
+            continue
+        if r < 0.06:
+            out.append(BASES[rng.integers(0, 4)])
+        else:
+            out.append(b)
+    return bytes(bytearray(out))
+
+
+def _write_inputs(d, n_contigs=2, n_reads=6, clen=300, seed=11):
+    rng = np.random.default_rng(seed)
+    drafts, reads, paf = [], [], []
+    for ci in range(n_contigs):
+        truth = BASES[rng.integers(0, 4, clen)]
+        draft = _mutate(rng, truth)
+        drafts.append(b">c%d\n%s\n" % (ci, draft))
+        for i in range(n_reads):
+            r = _mutate(rng, truth)
+            name = f"c{ci}r{i}"
+            reads.append(b">" + name.encode() + b"\n" + r + b"\n")
+            paf.append(f"{name}\t{len(r)}\t0\t{len(r)}\t+\tc{ci}"
+                       f"\t{len(draft)}\t0\t{len(draft)}"
+                       f"\t{min(len(r), len(draft))}"
+                       f"\t{max(len(r), len(draft))}\t60")
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "draft.fasta"), "wb") as fh:
+        fh.write(b"".join(drafts))
+    with open(os.path.join(d, "reads.fasta"), "wb") as fh:
+        fh.write(b"".join(reads))
+    with open(os.path.join(d, "ovl.paf"), "w") as fh:
+        fh.write("\n".join(paf) + "\n")
+
+
+def _spec_for(d):
+    return JobSpec(os.path.join(d, "reads.fasta"),
+                   os.path.join(d, "ovl.paf"),
+                   os.path.join(d, "draft.fasta"), backend="jax")
+
+
+def _solo_cli_bytes(d):
+    from racon_tpu import cli
+    stdout = io.StringIO()
+    stdout.buffer = io.BytesIO()
+    with contextlib.redirect_stdout(stdout), \
+            contextlib.redirect_stderr(io.StringIO()):
+        rc = cli.main(["--backend", "jax",
+                       os.path.join(d, "reads.fasta"),
+                       os.path.join(d, "ovl.paf"),
+                       os.path.join(d, "draft.fasta")])
+    assert rc == 0
+    return stdout.buffer.getvalue()
+
+
+def _wait_finished(job, timeout_s=120.0):
+    assert job.finished.wait(timeout_s), \
+        f"job {job.id} still {job.state} after {timeout_s}s"
+
+
+def test_daemon_jobs_match_solo_cli(tmp_path):
+    """Tentpole acceptance (in-process half): concurrent jobs from two
+    tenants through the shared batcher produce byte-identical output to
+    solo serial CLI runs, and their windows co-ride dispatches."""
+    from racon_tpu.server.daemon import PolishServer
+
+    d1 = str(tmp_path / "in1")
+    d2 = str(tmp_path / "in2")
+    _write_inputs(d1, seed=11)
+    _write_inputs(d2, seed=22)
+    base1 = _solo_cli_bytes(d1)
+    base2 = _solo_cli_bytes(d2)
+    obs_metrics.reset()
+
+    server = PolishServer(str(tmp_path / "state"))
+    j1 = server.submit("acme", _spec_for(d1))
+    j2 = server.submit("umbrella", _spec_for(d2))
+    _wait_finished(j1)
+    _wait_finished(j2)
+    for b in server._batchers.values():
+        b.close()
+    assert (j1.state, j2.state) == ("done", "done"), (j1.error, j2.error)
+    assert j1.result_bytes() == base1
+    assert j2.result_bytes() == base2
+    snap = obs_metrics.registry().snapshot()
+    assert snap["serve_jobs_submitted"] == 2
+    assert snap["serve_jobs_completed"] == 2
+    assert snap["serve_batches"] >= 1
+
+
+def test_daemon_http_surface(tmp_path):
+    """submit/status/stream/cancel over the wire, plus /healthz and the
+    OpenMetrics render."""
+    from racon_tpu.obs.export import validate_openmetrics
+    from racon_tpu.server.daemon import PolishServer, serve_http
+
+    d = str(tmp_path / "in")
+    _write_inputs(d)
+    base = _solo_cli_bytes(d)
+
+    server = PolishServer(str(tmp_path / "state"))
+    httpd = serve_http(server, "127.0.0.1", 0)
+    port = httpd.server_address[1]
+    url = f"http://127.0.0.1:{port}"
+    try:
+        body = json.dumps({
+            "tenant": "acme",
+            "sequences": os.path.join(d, "reads.fasta"),
+            "overlaps": os.path.join(d, "ovl.paf"),
+            "targets": os.path.join(d, "draft.fasta"),
+            "options": {"backend": "jax"}}).encode()
+        with urllib.request.urlopen(urllib.request.Request(
+                f"{url}/v1/jobs", data=body, method="POST")) as resp:
+            sub = json.loads(resp.read())
+        assert sub["id"] == "j0001"
+        _wait_finished(server.get(sub["id"]))
+        with urllib.request.urlopen(f"{url}/v1/jobs/{sub['id']}") as r:
+            status = json.loads(r.read())
+        assert status["state"] == "done", status
+        with urllib.request.urlopen(
+                f"{url}/v1/jobs/{sub['id']}/stream") as r:
+            assert r.headers["X-Racon-State"] == "done"
+            assert r.read() == base
+        with urllib.request.urlopen(f"{url}/healthz") as r:
+            health = json.loads(r.read())
+        assert health["status"] == "ok"
+        assert health["serve"]["jobs"][0]["id"] == "j0001"
+        with urllib.request.urlopen(f"{url}/metrics") as r:
+            assert validate_openmetrics(r.read().decode()) == []
+        # Cancel on a terminal job is a no-op acknowledgment.
+        with urllib.request.urlopen(urllib.request.Request(
+                f"{url}/v1/jobs/{sub['id']}/cancel",
+                method="POST")) as r:
+            assert json.loads(r.read())["state"] == "done"
+        # Unknown job -> 404.
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{url}/v1/jobs/j9999")
+        assert ei.value.code == 404
+    finally:
+        httpd.shutdown()
+        for b in server._batchers.values():
+            b.close()
+
+
+def test_daemon_restart_resumes_byte_identical(tmp_path):
+    """Restart-recovery contract: a job interrupted mid-run (fault at
+    the serve/commit site after the first contig committed, journal
+    still saying "running" — the exact on-disk state a SIGKILL leaves)
+    is re-queued by a fresh daemon, re-emits the committed prefix from
+    the shard, and finishes byte-identical to a solo serial CLI run."""
+    from racon_tpu.server.daemon import PolishServer
+
+    d = str(tmp_path / "in")
+    _write_inputs(d, n_contigs=3)
+    base = _solo_cli_bytes(d)
+    state = str(tmp_path / "state")
+
+    faults.configure("serve/commit:1!raise")
+    server1 = PolishServer(state)
+    job = server1.submit("acme", _spec_for(d))
+    _wait_finished(job)
+    for b in server1._batchers.values():
+        b.close()
+    assert job.state == "failed"
+    assert job.n_committed == 1, "expected exactly one committed contig"
+    # A killed daemon never reaches the terminal journal write: restore
+    # the journal to the state SIGKILL would have left it in.
+    job.state = "running"
+    job.persist()
+
+    faults.configure(None)
+    obs_metrics.reset()
+    server2 = PolishServer(state)
+    resumed = server2.recover()
+    assert resumed == 1
+    job2 = server2.get(job.id)
+    _wait_finished(job2)
+    for b in server2._batchers.values():
+        b.close()
+    assert job2.state == "done", job2.error
+    assert job2.result_bytes() == base
+    snap = obs_metrics.registry().snapshot()
+    assert snap["serve_jobs_resumed"] == 1
+    assert snap["res_ckpt_skips"] >= 1, "committed prefix not re-emitted"
+
+    # Third instance: the terminal job survives restart read-only with
+    # the exact same stream rebuilt from its store.
+    server3 = PolishServer(state)
+    assert server3.recover() == 0
+    assert server3.get(job.id).state == "done"
+    assert server3.get(job.id).result_bytes() == base
+
+
+def test_daemon_submit_fault_and_cancel(tmp_path):
+    """serve/submit faults surface to the submitter before any journal
+    write; cancelling a queued job never runs it."""
+    from racon_tpu.server.daemon import PolishServer
+
+    d = str(tmp_path / "in")
+    _write_inputs(d)
+    server = PolishServer(str(tmp_path / "state"))
+
+    faults.configure("serve/submit:0")
+    with pytest.raises(Exception):
+        server.submit("acme", _spec_for(d))
+    assert scan(server.jobs_root) == []
+
+    faults.configure(None)
+    # Cancel racing the runner start: whichever side wins, the job ends
+    # terminal and the journal agrees.
+    job = server.submit("acme", _spec_for(d))
+    server.cancel(job.id)
+    _wait_finished(job)
+    for b in server._batchers.values():
+        b.close()
+    assert job.state in ("cancelled", "done")
+    assert scan(server.jobs_root)[0].state == job.state
