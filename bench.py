@@ -206,6 +206,56 @@ def _serve_bench(backend: str, coverage: int, wlen: int) -> dict:
     return out
 
 
+def _cache_bench(backend: str, coverage: int, wlen: int) -> dict:
+    """Result-cache micro-bench (metric_version 14): one job's windows
+    run twice through a WindowMemo-armed CrossRequestBatcher
+    (racon_tpu/cache/ + server/batch.py). The cold pass dispatches and
+    memoizes; the warm resubmit must be served entirely from the memo —
+    the engine sees zero windows — with consensus byte-identical to a
+    plain solo pass. Publishes cache_resubmit_speedup and cold/warm
+    jobs-per-minute next to the cache_* registry extras
+    (hits/misses/stores/bytes, cache_hit_ratio)."""
+    from racon_tpu.cache import WindowMemo
+    from racon_tpu.obs import metrics as obs_metrics
+    from racon_tpu.ops.poa import PoaEngine
+    from racon_tpu.server.batch import CrossRequestBatcher
+
+    n = 32
+    ref = build_windows(n, coverage, wlen, seed=29)
+    PoaEngine(backend=backend).consensus_windows(ref)
+    memo = WindowMemo(("cache-bench",))
+
+    def _pass() -> tuple:
+        windows = build_windows(n, coverage, wlen, seed=29)
+        batcher = CrossRequestBatcher(PoaEngine(backend=backend),
+                                      capacity=n, wait_s=0.05,
+                                      memo=memo).start()
+        t0 = time.perf_counter()
+        try:
+            assert batcher.consensus("jc", "acme", windows) == n
+        finally:
+            dt = time.perf_counter() - t0
+            batcher.close()
+        return windows, dt
+
+    before = obs_metrics.registry().snapshot()
+    cold_windows, dt_cold = _pass()
+    warm_windows, dt_warm = _pass()
+    after = obs_metrics.registry().snapshot()
+    assert [w.consensus for w in cold_windows] == \
+        [w.consensus for w in ref], "cold cached consensus diverged"
+    assert [w.consensus for w in warm_windows] == \
+        [w.consensus for w in ref], "memo-served consensus diverged"
+    assert after.get("cache_hits_total", 0) - \
+        before.get("cache_hits_total", 0) == n, \
+        "warm resubmit was not served from the window memo"
+    out = dict(obs_metrics.result_cache_extras())
+    out["cache_resubmit_speedup"] = round(dt_cold / max(dt_warm, 1e-9), 2)
+    out["cache_cold_jobs_per_min"] = round(60.0 / max(dt_cold, 1e-9), 2)
+    out["cache_warm_jobs_per_min"] = round(60.0 / max(dt_warm, 1e-9), 2)
+    return out
+
+
 def main():
     from racon_tpu.utils.jaxcache import enable_compile_cache
     enable_compile_cache()
@@ -446,13 +496,27 @@ def main():
                      if k.startswith("dp_")}
     ingest_bench_extras = _ingest_bench()
     serve_bench_extras = _serve_bench(backend, coverage, wlen)
+    cache_bench_extras = _cache_bench(backend, coverage, wlen)
     extras = {**sched_extras, **e2e_transfers, **pipe_extras,
               **walk_bench_extras, **probe_extras, **adaptive_extras,
               **cache_extras(), **obs_metrics.resilience_extras(),
               **obs_metrics.ovl_extras(), **obs_metrics.dist_extras(),
               **obs_metrics.redo_extras(), **obs_metrics.ingest_extras(),
-              **ingest_bench_extras, **serve_bench_extras, **dp_extras}
+              **ingest_bench_extras, **serve_bench_extras,
+              **cache_bench_extras, **dp_extras}
     out = {
+        # metric_version 14: same primary value as versions 2-13 (the
+        # compute bench is untouched — the result cache sits in front
+        # of the engine, it never changes what the engine computes).
+        # New in 14: the result-cache extras from the resubmission
+        # drill (_cache_bench; the same job's windows twice through a
+        # WindowMemo-armed batcher, warm pass asserted fully
+        # memo-served and byte-identical to a solo pass) —
+        # cache_resubmit_speedup (cold wall / warm wall),
+        # cache_cold_jobs_per_min / cache_warm_jobs_per_min, and the
+        # cache_* registry accounting (cache_hits_total /
+        # cache_misses_total / cache_stores_total / cache_bytes /
+        # cache_hit_ratio) via result_cache_extras — see docs/CACHE.md.
         # metric_version 13: same primary value as versions 2-12 (the
         # compute bench is untouched — the serve plane wraps the same
         # engine, it does not change it). New in 13: the serve_* extras
@@ -562,7 +626,7 @@ def main():
         # fixed_engine_windows_per_sec. Bump this whenever the primary
         # value's definition changes, so round-over-round comparisons
         # can't silently mix metrics.
-        "metric_version": 13,
+        "metric_version": 14,
         "metric": f"POA windows/sec/chip, compute-only (direct-timed warm "
                   f"production chunk, convergence-scheduled refinement "
                   f"rounds — racon_tpu/sched/, telemetry in sched_* "

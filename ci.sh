@@ -83,4 +83,7 @@ python scripts/ingest_smoke.py
 echo "[ci] server smoke (daemon, 3 jobs/2 tenants, cross-request occupancy > solo, kill+restart byte-diff)"
 python scripts/server_smoke.py
 
+echo "[ci] cache smoke (CAS resubmit = zero dispatches, torn-entry drill, CACHE=0 fallback, byte-diff)"
+python scripts/cache_smoke.py
+
 echo "[ci] OK"

@@ -105,6 +105,13 @@ def build_parser() -> argparse.ArgumentParser:
                          "contigs re-emit byte-identically from the "
                          "shard, only the rest recompute; refuses if "
                          "inputs or output-affecting options changed")
+    ap.add_argument("--cache-dir", metavar="DIR", default=None,
+                    help="arm the content-addressed result cache in "
+                         "DIR: a run whose inputs + options fingerprint "
+                         "matches a stored entry re-emits it "
+                         "byte-identically with zero consensus "
+                         "dispatches (verify-on-hit; RACON_TPU_CACHE=0 "
+                         "disables — see docs/CACHE.md)")
     ap.add_argument("--ledger-dir", metavar="DIR", default=None,
                     help="join (or start) the contig work ledger in "
                          "DIR as one worker of a preemptible fleet: "
@@ -253,6 +260,11 @@ def main(argv: Optional[List[str]] = None) -> int:
               "checkpoints itself; drop --checkpoint-dir/--resume!",
               file=sys.stderr)
         return 1
+    if args.ledger_dir and args.cache_dir:
+        print("[racon_tpu::] error: --cache-dir is a whole-run store; "
+              "it does not compose with --ledger-dir's per-shard "
+              "leases!", file=sys.stderr)
+        return 1
     if args.ledger_dir and args.workers < 1:
         print(f"[racon_tpu::] error: invalid --workers {args.workers}!",
               file=sys.stderr)
@@ -313,6 +325,19 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"[racon_tpu::] resuming: {len(store.committed)} "
                   f"contig(s) already committed in "
                   f"{args.checkpoint_dir}", file=sys.stderr)
+
+    # Serial-CLI Tier-1 cache: armed only by --cache-dir (the daemon
+    # arms by default), globally killable via RACON_TPU_CACHE=0.
+    result_cache = None
+    if args.cache_dir:
+        from racon_tpu.cache import cache_enabled
+        if cache_enabled():
+            from racon_tpu.cache import ResultCache
+            try:
+                result_cache = ResultCache(args.cache_dir)
+            except Exception as exc:
+                print(str(exc), file=sys.stderr)
+                return 1
 
     import signal
     import threading
@@ -376,10 +401,40 @@ def main(argv: Optional[List[str]] = None) -> int:
                               f"recompute of {n_skip} window(s)",
                               file=sys.stderr)
 
-                polish_job(make_polisher,
-                           drop_unpolished=not args.include_unpolished,
-                           store=store, emit=out.write,
-                           hooks=JobHooks(on_resume=_resume_log))
+                # Cache probe/store only applies to runs starting from
+                # scratch — a resumed run's committed prefix already
+                # owns the output interleaving.
+                fresh = store is None or not store.committed
+                hit = None
+                if result_cache is not None and fresh:
+                    hit = result_cache.load(spec.fingerprint())
+                if hit is not None:
+                    from racon_tpu.cache import replay_records
+                    n = replay_records(hit, emit=out.write, store=store)
+                    print(f"[racon_tpu::] cache: re-emitted {n} "
+                          f"contig(s) from {args.cache_dir} (zero "
+                          f"consensus dispatches)", file=sys.stderr)
+                else:
+                    captured = [] if (result_cache is not None and
+                                      fresh) else None
+
+                    def _capture(tid, rec):
+                        if rec is None:
+                            captured.append((tid, None, b""))
+                        else:
+                            captured.append((tid, rec.name.encode(),
+                                             rec.data))
+
+                    polish_job(
+                        make_polisher,
+                        drop_unpolished=not args.include_unpolished,
+                        store=store, emit=out.write,
+                        hooks=JobHooks(
+                            on_resume=_resume_log,
+                            after_commit=_capture
+                            if captured is not None else None))
+                    if captured is not None:
+                        result_cache.store(spec.fingerprint(), captured)
     except (PolisherError, ParseError, ValueError) as exc:
         print(str(exc), file=sys.stderr)
         return 1

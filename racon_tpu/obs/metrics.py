@@ -658,6 +658,69 @@ def serve_extras(reg: Optional[MetricsRegistry] = None
     return out
 
 
+# --------------------------------------------------- result cache plane
+
+
+def record_cache(tier: str, outcome: str, n: int = 1, nbytes: int = 0,
+                 reg: Optional[MetricsRegistry] = None) -> None:
+    """Account result-cache events (racon_tpu/cache/, docs/CACHE.md).
+    ``tier`` is ``job`` (the on-disk CAS) or ``window`` (the
+    in-batcher consensus memo); ``outcome`` is ``hit`` / ``miss`` /
+    ``store`` / ``evict`` / ``verify_fail``. ``n`` batches per-window
+    probes into one call so a 256-window chunk is one registry pass
+    and one trace point, not 256; ``nbytes`` (stores) feeds
+    ``cache_bytes``. The derived ``cache_hit_ratio`` gauge is
+    recomputed inside the same registry pass so it can never drift
+    from the totals it summarizes."""
+    reg = reg if reg is not None else _REGISTRY
+
+    def _mutate(v):
+        if outcome == "hit":
+            v["cache_hits_total"] = \
+                v.get("cache_hits_total", 0) + int(n)
+        elif outcome == "miss":
+            v["cache_misses_total"] = \
+                v.get("cache_misses_total", 0) + int(n)
+        elif outcome == "store":
+            v["cache_stores_total"] = \
+                v.get("cache_stores_total", 0) + int(n)
+        elif outcome == "evict":
+            v["cache_evictions_total"] = \
+                v.get("cache_evictions_total", 0) + int(n)
+        elif outcome == "verify_fail":
+            v["cache_verify_fail_total"] = \
+                v.get("cache_verify_fail_total", 0) + int(n)
+        else:
+            raise ValueError(f"[racon_tpu::metrics] unknown cache "
+                             f"outcome {outcome!r}")
+        if nbytes:
+            v["cache_bytes"] = v.get("cache_bytes", 0) + int(nbytes)
+        seen = v.get("cache_hits_total", 0) + \
+            v.get("cache_misses_total", 0)
+        if seen:
+            v["cache_hit_ratio"] = round(
+                v.get("cache_hits_total", 0) / seen, 4)
+
+    reg.apply(_mutate)
+    _trace.get_tracer().point("cache", outcome, tier=str(tier),
+                              outcome=str(outcome), n=int(n),
+                              bytes=int(nbytes))
+
+
+def result_cache_extras(reg: Optional[MetricsRegistry] = None
+                        ) -> Dict[str, object]:
+    """The registry's cache_* keys as a JSON-ready dict (bench extras
+    metric_version 14 / obs_report "cache:" section); named to stay
+    clear of utils/jaxcache.cache_extras, the compile-cache gauges.
+    Empty when nothing probed the result cache."""
+    reg = reg if reg is not None else _REGISTRY
+    out: Dict[str, object] = {}
+    for k, v in sorted(reg.snapshot().items()):
+        if k.startswith("cache_"):
+            out[k] = round(v, 4) if isinstance(v, float) else v
+    return out
+
+
 # ------------------------------------------------------- sched telemetry
 
 #: Canonical sched_* registry keys (docs/SCHEDULER.md documents each).
@@ -735,6 +798,10 @@ _MERGE_LAST_KEYS = frozenset({
     # occupancy, completion rate — the serve_* event/window counters
     # sum and serve_queue_depth_peak maxes via its suffix.
     "serve_active_jobs", "serve_batch_occupancy", "serve_jobs_per_min",
+    # Result-cache derived gauge (record_cache above): the hit ratio
+    # re-derives from the totals on every event, so the most recent
+    # snapshot wins — the cache_* hit/miss/store/evict counters sum.
+    "cache_hit_ratio",
 })
 
 
@@ -766,6 +833,13 @@ METRIC_SPECS = (
     ("adaptive_rounds_executed", MERGE_SUM, "adaptive_rounds_executed"),
     ("adaptive_rounds_scheduled", MERGE_SUM, "adaptive_rounds_scheduled"),
     ("align_phase_seconds", MERGE_SUM, "align_phase_seconds"),
+    ("cache_bytes", MERGE_SUM, "cache_bytes"),
+    ("cache_evictions_total", MERGE_SUM, "cache_evictions_total"),
+    ("cache_hit_ratio", MERGE_LAST, "cache_hit_ratio"),
+    ("cache_hits_total", MERGE_SUM, "cache_hits_total"),
+    ("cache_misses_total", MERGE_SUM, "cache_misses_total"),
+    ("cache_stores_total", MERGE_SUM, "cache_stores_total"),
+    ("cache_verify_fail_total", MERGE_SUM, "cache_verify_fail_total"),
     ("d2h_bytes", MERGE_SUM, "d2h_bytes"),
     ("d2h_s", MERGE_SUM, "d2h_s"),
     ("d2h_transfers", MERGE_SUM, "d2h_transfers"),

@@ -96,6 +96,7 @@ _ACTIONS = ("raise", "kill", "term", "int", "torn", "hang", "stall")
 #: here, and every listed site must be exercised by at least one test
 #: or smoke script. Keep alphabetical.
 SITES = (
+    "cache/load", "cache/store",
     "ckpt/commit", "ckpt/manifest",
     "d2h/align", "d2h/chunk", "d2h/sp",
     "dispatch/chunk", "dispatch/walk",

@@ -33,6 +33,13 @@ Mechanics:
   dispatch-class watchdog deadline scaled by the batch's cell volume
   (ops/budget.py), so a wedged device turns into a typed error on the
   affected jobs instead of a silent hang.
+- With a :class:`~racon_tpu.cache.memo.WindowMemo` attached (Tier 2
+  of the result cache, docs/CACHE.md), each window is probed by
+  content digest *before* it is packed into a work item: hits take
+  their memoized consensus in place and never reach the device, so a
+  job partially overlapping earlier work dispatches only the delta.
+  Misses are memoized after their dispatch retires. ``memo=None``
+  (the ``RACON_TPU_CACHE=0`` path) is byte-for-byte today's behavior.
 """
 
 from __future__ import annotations
@@ -104,8 +111,9 @@ class CrossRequestBatcher:
 
     def __init__(self, engine, capacity: Optional[int] = None,
                  wait_s: Optional[float] = None,
-                 queue_cap: Optional[int] = None):
+                 queue_cap: Optional[int] = None, memo=None):
         self.engine = engine
+        self.memo = memo
         self.capacity = capacity if capacity is not None \
             else batch_capacity()
         self.wait_s = wait_s if wait_s is not None else batch_wait_s()
@@ -146,9 +154,31 @@ class CrossRequestBatcher:
         """
         if not windows:
             return 0
+        pending = windows
+        n_memo = 0
+        if self.memo is not None:
+            # Tier-2 probe: memoized windows take their consensus in
+            # place and never enter the dispatch stream, so only the
+            # delta reaches the device (serve_batch_windows counts it).
+            from racon_tpu.obs.metrics import record_cache
+            pending, hits = [], []
+            for w in windows:
+                val = self.memo.get(w)
+                if val is None:
+                    pending.append(w)
+                else:
+                    w.consensus, w.polished = val
+                    hits.append(w)
+            if hits:
+                record_cache("window", "hit", n=len(hits))
+            if pending:
+                record_cache("window", "miss", n=len(pending))
+            n_memo = sum(1 for w in hits if w.polished)
+            if not pending:
+                return n_memo
         items = [_WorkItem(job_id, tenant,
-                           windows[s:s + self.capacity])
-                 for s in range(0, len(windows), self.capacity)]
+                           pending[s:s + self.capacity])
+                 for s in range(0, len(pending), self.capacity)]
         for it in items:
             self._admit.put(it)  # blocks at capacity: admission control
         from racon_tpu.obs.metrics import registry
@@ -161,7 +191,17 @@ class CrossRequestBatcher:
                     f"[racon_tpu::serve] job {it.job_id}: batch "
                     f"dispatch failed: {it.error}") from it.error
             n += it.polished
-        return n
+        if self.memo is not None:
+            from racon_tpu.obs.metrics import record_cache
+            stored = nbytes = 0
+            for w in pending:
+                sz = self.memo.put(w)
+                if sz is not None:
+                    stored += 1
+                    nbytes += sz
+            if stored:
+                record_cache("window", "store", n=stored, nbytes=nbytes)
+        return n + n_memo
 
     # ---------------------------------------------------- dispatcher side
 

@@ -33,6 +33,15 @@ rework and zero output differences.
 The daemon forces the in-process streaming pipeline off: concurrency
 comes from jobs sharing the batcher, not from stages inside one job,
 so the dispatcher thread stays the sole owner of device compute.
+
+The content-addressed result cache (racon_tpu/cache/, docs/CACHE.md)
+is armed by default (``RACON_TPU_CACHE=0`` disables): a fresh job
+whose fingerprint hits the job-level CAS replays its verified contig
+records straight into its store and stream — zero device dispatches —
+and every batcher carries a window memo so partially-overlapping jobs
+dispatch only the delta. Cache state lives under the state dir (or
+``RACON_TPU_CACHE_DIR``) and survives restarts via the same
+atomic-publication recovery contract as the job journal.
 """
 
 from __future__ import annotations
@@ -44,6 +53,9 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from racon_tpu.cache import (ResultCache, WindowMemo, cache_dir_for,
+                             cache_enabled, records_from_store,
+                             replay_records, window_memo_enabled)
 from racon_tpu.server.batch import BatchedEngineProxy, CrossRequestBatcher
 from racon_tpu.server.engine import (EngineSession, JobHooks, JobSpec,
                                      build_polisher, polish_job)
@@ -78,6 +90,12 @@ class PolishServer:
         self._sem = threading.BoundedSemaphore(
             max(1, int(envspec.read(ENV_MAX_JOBS))))
         self._t0 = time.perf_counter()
+        # Tier-1 CAS, on by default for the daemon; the constructor
+        # reloads the atomically-published index (journal-aware
+        # recovery — no payload re-verification on restart).
+        self.cache: Optional[ResultCache] = None
+        if cache_enabled():
+            self.cache = ResultCache(cache_dir_for(state_dir))
 
     # ------------------------------------------------------- lifecycle
 
@@ -186,8 +204,16 @@ class PolishServer:
             b = self._batchers.get(key)
             if b is None:
                 engine = self.session.engine_for(spec)
+                memo = None
+                if self.cache is not None and window_memo_enabled():
+                    # Tier-2 memo, spilling under the cache root; one
+                    # memo per scoring key, same sharing rule as the
+                    # batcher itself.
+                    memo = WindowMemo(
+                        key,
+                        spill_dir=self.cache.window_spill_dir(key))
                 b = self._batchers[key] = \
-                    CrossRequestBatcher(engine).start()
+                    CrossRequestBatcher(engine, memo=memo).start()
             return b
 
     def _run_job(self, job: Job) -> None:
@@ -204,6 +230,27 @@ class PolishServer:
                 self._finish(job, "failed", str(exc))
                 return
             job.n_committed = len(store.committed)
+            if self.cache is not None and not store.committed:
+                # Tier-1 probe (fresh jobs only — a resumed job's
+                # committed prefix already owns the store): a verified
+                # CAS hit replays the whole result through the same
+                # emit-then-commit order polish_job uses, so /stream,
+                # the journal, and restart recovery are identical to a
+                # fresh run — with zero device dispatches.
+                records = self.cache.load(job.spec.fingerprint())
+                if records is not None:
+                    try:
+                        replay_records(records, emit=job.emit,
+                                       store=store)
+                    except Exception as exc:
+                        job.n_committed = len(store.committed)
+                        store.close()
+                        self._finish(job, "failed", str(exc))
+                        return
+                    job.n_committed = len(store.committed)
+                    store.close()
+                    self._finish(job, "done", None)
+                    return
             proxy = BatchedEngineProxy(self._batcher_for(job.spec),
                                        job.id, job.tenant)
 
@@ -230,6 +277,21 @@ class PolishServer:
                 state = "cancelled"
             except Exception as exc:
                 state, error = "failed", str(exc)
+            else:
+                if self.cache is not None:
+                    # Store the finished result under the job
+                    # fingerprint. The job outcome is never coupled to
+                    # cache health: injected cache/store faults are
+                    # swallowed inside store(), and a genuinely failing
+                    # store (disk full) costs the cache entry, not the
+                    # job.
+                    try:
+                        self.cache.store(job.spec.fingerprint(),
+                                         records_from_store(store))
+                    except Exception as exc:
+                        print(f"[racon_tpu::serve] cache store failed "
+                              f"for job {job.id}: {exc}",
+                              file=sys.stderr)
             finally:
                 job.n_committed = len(store.committed)
                 store.close()

@@ -66,6 +66,18 @@ def read(name: str) -> str:
 # The registry.  Grouped by doc file; keep alphabetical within groups.
 # --------------------------------------------------------------------
 
+# docs/CACHE.md — content-addressed result cache
+declare("RACON_TPU_CACHE", "", "flag", "CACHE.md",
+        "result-cache master gate: on by default for the daemon (the "
+        "serial CLI needs --cache-dir); 0/false disables both tiers")
+declare("RACON_TPU_CACHE_DIR", "", "path", "CACHE.md",
+        "cache root override (daemon default: <state-dir>/cache)")
+declare("RACON_TPU_CACHE_MAX_MB", "256", "int", "CACHE.md",
+        "job-level CAS byte bound; LRU eviction keeps it under this")
+declare("RACON_TPU_CACHE_WINDOWS", "", "flag", "CACHE.md",
+        "window memoization gate: on whenever the cache is on; "
+        "0/false keeps Tier 1 but disables the in-batcher memo")
+
 # docs/DISTRIBUTED.md — fleet, ledger, autoscaler
 declare("RACON_TPU_AUTOSCALE_DEADLINE_S", "", "float", "DISTRIBUTED.md",
         "autoscaler run deadline: give up replacing workers after this")

@@ -1,0 +1,260 @@
+"""CI smoke: the content-addressed result cache, end to end.
+
+0. **Serial CLI**: ``--cache-dir`` resubmission re-emits from the job
+   CAS (stderr announces zero consensus dispatches) byte-identical to
+   the cold run.
+1. **Daemon resubmit**: an identical job resubmitted to a real daemon
+   is served from the CAS — ``serve_batch_windows`` does not move, the
+   stream is byte-identical to the serial baseline — and a *restarted*
+   daemon keeps hitting through its recovered index. The daemon's
+   trace satisfies the ``cache`` span contract and obs_report renders
+   a ``cache:`` section from it.
+2. **Poisoning drill**: ``cache/load:0!torn`` tears the first probe;
+   verify-on-hit demotes it to a miss (``cache_verify_fail_total``),
+   the job recomputes, and the bytes never change.
+3. **Disabled fallback**: ``RACON_TPU_CACHE=0`` over the same
+   populated state recomputes byte-identically and records no
+   ``cache_*`` metrics at all.
+
+Subprocess daemons (not in-process PolishServer) so each phase's
+env-gated knobs arm independently and restart recovery is real.
+"""
+
+import io
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np                                   # noqa: E402
+
+BASES = np.frombuffer(b"ACGT", np.uint8)
+BOOT = "import sys; from racon_tpu import cli; sys.exit(cli.main(sys.argv[1:]))"
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _noisy(rng, truth):
+    out = []
+    for b in truth:
+        r = rng.random()
+        if r < 0.03:
+            continue
+        out.append(int(rng.integers(0, 4)) if r < 0.06 else int(
+            np.searchsorted(BASES, b)))
+    return bytes(BASES[np.array(out)])
+
+
+def _write_inputs(d, n_contigs=3, seed=23):
+    rng = np.random.default_rng(seed)
+    drafts, reads, paf = [], [], []
+    for c in range(n_contigs):
+        truth = BASES[rng.integers(0, 4, 300 + 40 * c)]
+        draft = _noisy(rng, truth)
+        drafts.append(b">c%d\n%s\n" % (c, draft))
+        for i in range(6):
+            r = _noisy(rng, truth)
+            rid = f"r{c}_{i}"
+            reads.append(b">%s\n%s\n" % (rid.encode(), r))
+            paf.append(f"{rid}\t{len(r)}\t0\t{len(r)}\t+\tc{c}"
+                       f"\t{len(draft)}\t0\t{len(draft)}"
+                       f"\t{min(len(r), len(draft))}"
+                       f"\t{max(len(r), len(draft))}\t60")
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "draft.fasta"), "wb") as fh:
+        fh.write(b"".join(drafts))
+    with open(os.path.join(d, "reads.fasta"), "wb") as fh:
+        fh.write(b"".join(reads))
+    with open(os.path.join(d, "ovl.paf"), "w") as fh:
+        fh.write("\n".join(paf) + "\n")
+
+
+def _cli(d, extra=()):
+    e = dict(os.environ)
+    e.pop("RACON_TPU_FAULTS", None)
+    e.pop("RACON_TPU_TRACE", None)
+    proc = subprocess.run(
+        [sys.executable, "-c", BOOT, "--backend", "jax", *extra,
+         os.path.join(d, "reads.fasta"), os.path.join(d, "ovl.paf"),
+         os.path.join(d, "draft.fasta")],
+        capture_output=True, env=e, cwd=ROOT)
+    assert proc.returncode == 0, proc.stderr.decode()
+    return proc.stdout, proc.stderr.decode()
+
+
+# ------------------------------------------------------------ daemon ops
+
+
+def _start_daemon(state, env=None):
+    e = dict(os.environ)
+    e.pop("RACON_TPU_FAULTS", None)
+    e.pop("RACON_TPU_TRACE", None)
+    e.update(env or {})
+    os.makedirs(state, exist_ok=True)
+    port_file = os.path.join(state, "port")
+    if os.path.exists(port_file):
+        os.remove(port_file)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "racon_tpu.server", "--state-dir", state,
+         "--port", "0"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, env=e,
+        cwd=ROOT)
+    deadline = time.monotonic() + 180
+    while not os.path.exists(port_file):
+        if proc.poll() is not None:
+            raise AssertionError("daemon died on startup:\n" +
+                                 proc.stderr.read().decode())
+        if time.monotonic() > deadline:
+            proc.kill()
+            raise AssertionError("daemon never published its port")
+        time.sleep(0.05)
+    with open(port_file) as fh:
+        port = int(fh.read().strip())
+    return proc, port
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30) as resp:
+        return resp.read()
+
+
+def _submit(port, d):
+    body = json.dumps({
+        "tenant": "acme",
+        "sequences": os.path.join(d, "reads.fasta"),
+        "overlaps": os.path.join(d, "ovl.paf"),
+        "targets": os.path.join(d, "draft.fasta"),
+        "options": {"backend": "jax"}}).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/jobs", data=body, method="POST")
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return json.loads(resp.read())["id"]
+
+
+def _wait_done(port, job_id, timeout_s=300):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        status = json.loads(_get(port, f"/v1/jobs/{job_id}"))
+        if status["state"] in ("done", "failed", "cancelled"):
+            assert status["state"] == "done", status
+            return
+        time.sleep(0.2)
+    raise AssertionError(f"job {job_id} did not finish in {timeout_s}s")
+
+
+def _metric(port, name, default=0.0):
+    text = _get(port, "/metrics").decode()
+    m = re.search(rf"^{re.escape(name)} (\S+)$", text, re.MULTILINE)
+    return float(m.group(1)) if m else default
+
+
+def _run_job(port, d, base):
+    jid = _submit(port, d)
+    _wait_done(port, jid)
+    stream = _get(port, f"/v1/jobs/{jid}/stream")
+    assert stream == base, f"job {jid} stream differs from serial CLI"
+    return jid
+
+
+def _drain(proc):
+    proc.send_signal(signal.SIGTERM)
+    rc = proc.wait(timeout=120)
+    assert rc == 0, ("daemon drain not clean (rc {}):\n".format(rc) +
+                     proc.stderr.read().decode())
+
+
+def main():
+    with tempfile.TemporaryDirectory() as d:
+        inp = os.path.join(d, "in")
+        _write_inputs(inp)
+        base, _ = _cli(inp)
+        assert base.count(b">") == 3
+
+        # --- phase 0: serial CLI --cache-dir resubmission.
+        cdir = os.path.join(d, "cli-cache")
+        cold, err_cold = _cli(inp, extra=("--cache-dir", cdir))
+        assert cold == base
+        assert "cache: re-emitted" not in err_cold
+        warm, err_warm = _cli(inp, extra=("--cache-dir", cdir))
+        assert warm == base, "CLI cache hit changed bytes"
+        assert "cache: re-emitted" in err_warm and \
+            "zero consensus dispatches" in err_warm, err_warm
+        print("[cache-smoke] CLI --cache-dir resubmit byte-identical, "
+              "re-emitted from CAS", flush=True)
+
+        # --- phase 1: daemon resubmit = zero consensus dispatches.
+        state = os.path.join(d, "s1")
+        trace = os.path.join(d, "cache.jsonl")
+        proc, port = _start_daemon(state, env={
+            "RACON_TPU_SERVE_BATCH": "16", "RACON_TPU_TRACE": trace})
+        _run_job(port, inp, base)
+        windows_cold = _metric(port, "racon_tpu_serve_batch_windows_total")
+        assert windows_cold > 0
+        _run_job(port, inp, base)
+        windows_warm = _metric(port, "racon_tpu_serve_batch_windows_total")
+        assert windows_warm == windows_cold, (
+            f"resubmit dispatched windows: {windows_warm} != {windows_cold}")
+        assert _metric(port, "racon_tpu_cache_hits_total") >= 1
+        assert _metric(port, "racon_tpu_cache_hit_ratio") > 0
+        _drain(proc)
+
+        # Restarted daemon hits through the recovered index.
+        proc, port = _start_daemon(state, env={
+            "RACON_TPU_SERVE_BATCH": "16"})
+        _run_job(port, inp, base)
+        assert _metric(port, "racon_tpu_serve_batch_windows_total") == 0, \
+            "restarted daemon recomputed despite a recovered CAS index"
+        assert _metric(port, "racon_tpu_cache_hits_total") >= 1
+        _drain(proc)
+
+        from scripts import obs_report
+        tr = obs_report.load_trace(trace)
+        errs = obs_report.validate(tr)
+        assert not errs, "trace schema violations:\n" + "\n".join(errs)
+        kinds = {s["kind"] for s in tr["spans"].values()}
+        assert "cache" in kinds, kinds
+        buf = io.StringIO()
+        obs_report.render(tr, out=buf)
+        assert "cache:" in buf.getvalue(), buf.getvalue()
+        print(f"[cache-smoke] daemon resubmit byte-identical with zero "
+              f"dispatches ({windows_cold:.0f} cold windows, 0 warm; "
+              f"index survives restart; trace valid, cache section "
+              f"renders)", flush=True)
+
+        # --- phase 2: torn-entry poisoning drill over the warm CAS.
+        proc, port = _start_daemon(state, env={
+            "RACON_TPU_SERVE_BATCH": "16",
+            "RACON_TPU_FAULTS": "cache/load:0!torn"})
+        _run_job(port, inp, base)
+        assert _metric(port, "racon_tpu_cache_verify_fail_total") >= 1, \
+            "torn probe did not register a verify failure"
+        assert _metric(port, "racon_tpu_serve_batch_windows_total") > 0, \
+            "torn probe was served instead of recomputed"
+        _drain(proc)
+        print("[cache-smoke] torn entry quarantined: verify-fail "
+              "counted, recompute byte-identical", flush=True)
+
+        # --- phase 3: RACON_TPU_CACHE=0 falls back byte-identically.
+        proc, port = _start_daemon(state, env={
+            "RACON_TPU_SERVE_BATCH": "16", "RACON_TPU_CACHE": "0"})
+        _run_job(port, inp, base)
+        assert _metric(port, "racon_tpu_serve_batch_windows_total") > 0
+        text = _get(port, "/metrics").decode()
+        assert "racon_tpu_cache_" not in text, \
+            "cache metrics recorded with RACON_TPU_CACHE=0"
+        _drain(proc)
+        print("[cache-smoke] RACON_TPU_CACHE=0 recomputes "
+              "byte-identically, no cache accounting", flush=True)
+
+    print("[cache-smoke] PASS", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -68,6 +68,11 @@ KIND_REQUIRED_ATTRS = {
     # cross-request batch dispatch; job/tenant are comma-joined lists
     # on batch points so one dispatch names every rider.
     "serve": ("job", "tenant"),
+    # One result-cache event (racon_tpu/cache/ via obs/metrics.py
+    # record_cache): which tier (job CAS / window memo) and which
+    # outcome (hit/miss/store/evict/verify_fail) — per-window probes
+    # arrive batched, one point per chunk.
+    "cache": ("tier", "outcome"),
 }
 
 # Span kinds that carry no required attributes — structural intervals
@@ -256,6 +261,7 @@ def render(tr: Dict[str, object], out=None,
     _render_resilience(m, by_kind, out)
     _render_dist(m, by_kind, out)
     _render_server(m, by_kind, out)
+    _render_cache(m, by_kind, out)
     if fleet_dir:
         _render_fleet(fleet_dir, out)
     _render_redo(m, out)
@@ -476,6 +482,37 @@ def _render_server(m, by_kind, out) -> None:
         tenants = ", ".join(f"{t}: {n}" for t, n in
                             sorted(by_tenant.items()))
         print(f"  events by tenant: {tenants}", file=out)
+
+
+def _render_cache(m, by_kind, out) -> None:
+    """The "cache:" section: result-store totals (hits/misses/stores/
+    evictions/verify failures), the derived hit ratio and stored
+    bytes, and per-tier event counts, from the ``cache_*`` metrics and
+    ``cache`` points the content-addressed result cache records
+    (docs/CACHE.md). Runs that never probed the cache print nothing."""
+    m = m or {}
+    cache = {k: v for k, v in m.items() if k.startswith("cache_")}
+    spans = by_kind.get("cache", [])
+    if not cache and not spans:
+        return
+    print(f"\ncache: hits={int(m.get('cache_hits_total', 0))}  "
+          f"misses={int(m.get('cache_misses_total', 0))}  "
+          f"stores={int(m.get('cache_stores_total', 0))}  "
+          f"evictions={int(m.get('cache_evictions_total', 0))}  "
+          f"verify_fail={int(m.get('cache_verify_fail_total', 0))}",
+          file=out)
+    ratio = m.get("cache_hit_ratio")
+    if ratio is not None:
+        print(f"  hit_ratio={float(ratio):.4f}  "
+              f"bytes={int(m.get('cache_bytes', 0))}", file=out)
+    if spans:
+        by_tier: Dict[str, int] = {}
+        for s in spans:
+            key = f"{s.get('tier', '?')}/{s.get('outcome', '?')}"
+            by_tier[key] = by_tier.get(key, 0) + int(s.get("n", 1))
+        tiers = ", ".join(f"{t}: {n}" for t, n in
+                          sorted(by_tier.items()))
+        print(f"  events by tier: {tiers}", file=out)
 
 
 def _render_fleet(fleet_dir: str, out) -> None:

@@ -1,0 +1,27 @@
+"""Seeded cache-surface violations (lint fixture — never imported).
+
+One file, one violation per contract the cache subsystem extends:
+
+FLT001: a cache/* fault-site literal faults.SITES does not declare.
+MET001: a recorded cache_* key matching no METRIC_SPECS row.
+SPAN002: the ``cache`` span kind emitted without tier/outcome.
+ATM001: a bare write-mode open (racon_tpu/cache/ is ATM001-scoped).
+"""
+
+from racon_tpu.obs.metrics import registry
+from racon_tpu.resilience.faults import maybe_fault
+
+
+def poison():
+    maybe_fault("cache/bogus")                            # FLT001
+    registry().inc("cache_bogus_total")                   # MET001
+
+
+def emit(tracer):
+    with tracer.span("cache", "probe", note=1):           # SPAN002
+        pass
+
+
+def save(path, data):
+    with open(path, "w") as fh:                           # ATM001
+        fh.write(data)

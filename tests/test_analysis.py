@@ -209,6 +209,38 @@ def test_determinism_fires():
     assert len(found) == 2  # time.time AND random.random
 
 
+# ------------------------------------------------------- cache surface
+
+
+def test_cache_surface_rules_fire():
+    """The result-cache contract extensions (PR 16) are not vacuous:
+    one seeded fixture trips each registry the cache surface joined —
+    fault sites, metric specs, the ``cache`` span kind's attr schema,
+    and the ATM001 scope over racon_tpu/cache/."""
+    ctx = _fixture_ctx("cache_violation.py")
+    assert "FLT001" in _ids(_rule("fault-site").check(ctx))
+    assert "MET001" in _ids(_rule("metrics-contract").check(ctx))
+    assert "SPAN002" in _ids(_rule("span-schema").check(ctx))
+    assert "ATM001" in _ids(_rule("atomic-write").check(ctx))
+
+
+def test_cache_registries_registered():
+    """The registries themselves carry the cache rows: sites, metric
+    specs (with the MERGE_LAST hit-ratio gauge), and the span kind."""
+    from racon_tpu.resilience.faults import SITES
+    assert "cache/load" in SITES and "cache/store" in SITES
+    by_pattern = {p: k for p, k, _ in obs_metrics.METRIC_SPECS}
+    assert by_pattern["cache_hits_total"] == obs_metrics.MERGE_SUM
+    assert by_pattern["cache_hit_ratio"] == obs_metrics.MERGE_LAST
+    assert obs_metrics.merge_kind("cache_hit_ratio") == \
+        obs_metrics.MERGE_LAST
+    assert obs_metrics.merge_kind("cache_verify_fail_total") == \
+        obs_metrics.MERGE_SUM
+    sys.path.insert(0, REPO)
+    from scripts.obs_report import KIND_REQUIRED_ATTRS
+    assert KIND_REQUIRED_ATTRS["cache"] == ("tier", "outcome")
+
+
 # ------------------------------------------------------- engine mechanics
 
 
