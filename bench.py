@@ -156,10 +156,16 @@ def _serve_bench(backend: str, coverage: int, wlen: int) -> dict:
     CrossRequestBatcher over a warm engine (racon_tpu/server/batch.py),
     consensi asserted identical to a solo serial pass of the same
     windows — the per-window determinism invariant the daemon's
-    byte-identity rests on, exercised at bench geometry. Publishes
-    serve_jobs_per_min / serve_batch_occupancy and the rest of the
-    serve_* registry extras (batches, windows, tenant wait, queue
-    peak)."""
+    byte-identity rests on, exercised at bench geometry with the full
+    telemetry plane armed (histograms recording, flight ring live: the
+    identity assert doubles as the telemetry-on/off byte-identity
+    gate, since the solo reference pass above ran before any serve
+    telemetry was recorded). Publishes serve_jobs_per_min /
+    serve_batch_occupancy and the rest of the serve_* registry extras
+    (batches, windows, tenant wait, queue peak), plus (metric_version
+    15) p50/p95/p99 for serve_job_latency_s and dispatch_round_s and
+    the flight-recorder dump overhead, gated < 1% of the drill's
+    wall."""
     import threading
     from racon_tpu.obs import metrics as obs_metrics
     from racon_tpu.ops.poa import PoaEngine
@@ -181,8 +187,11 @@ def _serve_bench(backend: str, coverage: int, wlen: int) -> dict:
 
     def _job(idx: int, job_id: str, tenant: str) -> None:
         lo = idx * n_per_job
+        tj0 = time.perf_counter()
         results[job_id] = batcher.consensus(
             job_id, tenant, shared[lo:lo + n_per_job])
+        obs_metrics.record_hist("serve_job_latency_s",
+                                time.perf_counter() - tj0)
 
     threads = [threading.Thread(target=_job, args=(i, j, t),
                                 name=f"serve-bench-{j}")
@@ -203,6 +212,24 @@ def _serve_bench(backend: str, coverage: int, wlen: int) -> dict:
     out = dict(obs_metrics.serve_extras())
     out["serve_bench_jobs"] = len(jobs)
     out["serve_bench_seconds"] = round(dt, 4)
+    for family in ("serve_job_latency_s", "dispatch_round_s"):
+        out.update({k: round(v, 6) for k, v in
+                    obs_metrics.hist_percentiles(family).items()})
+    # Flight-recorder cost: one full ring dump (the most expensive
+    # thing the recorder ever does, and it only happens at teardown)
+    # must stay under 1% of the drill's wall — the always-armed ring
+    # may not tax the serve plane it exists to debug.
+    import tempfile
+    from racon_tpu.obs import flightrec
+    with tempfile.TemporaryDirectory() as flight_dir:
+        tf0 = time.perf_counter()
+        assert flightrec.dump(flight_dir, reason="bench"), \
+            "flight dump failed"
+        flight_dt = time.perf_counter() - tf0
+    assert flight_dt < 0.01 * dt, \
+        f"flight dump cost {flight_dt:.4f}s >= 1% of serve wall {dt:.4f}s"
+    out["flight_dump_seconds"] = round(flight_dt, 6)
+    out["flight_overhead_fraction"] = round(flight_dt / dt, 6)
     return out
 
 
@@ -505,6 +532,20 @@ def main():
               **ingest_bench_extras, **serve_bench_extras,
               **cache_bench_extras, **dp_extras}
     out = {
+        # metric_version 15: same primary value as versions 2-14 (the
+        # compute bench is untouched — telemetry observes the serve
+        # plane, it never changes what the engine computes; the serve
+        # drill's identity assert now doubles as the telemetry-on/off
+        # byte-identity gate, since the solo reference pass runs before
+        # any serve telemetry is recorded). New in 15: latency
+        # percentiles from the serve drill's log-spaced histograms
+        # (serve_job_latency_s_p50/p95/p99 — per-job wall through the
+        # batcher, dispatch_round_s_p50/p95/p99 — per-dispatch device
+        # wall, via obs/metrics.py hist_percentiles), plus the
+        # flight-recorder cost gate — one full ring dump timed and
+        # asserted < 1% of the serve drill's wall, published as
+        # flight_dump_seconds / flight_overhead_fraction (see
+        # docs/OBSERVABILITY.md "Crash flight recorder").
         # metric_version 14: same primary value as versions 2-13 (the
         # compute bench is untouched — the result cache sits in front
         # of the engine, it never changes what the engine computes).
@@ -626,7 +667,7 @@ def main():
         # fixed_engine_windows_per_sec. Bump this whenever the primary
         # value's definition changes, so round-over-round comparisons
         # can't silently mix metrics.
-        "metric_version": 14,
+        "metric_version": 15,
         "metric": f"POA windows/sec/chip, compute-only (direct-timed warm "
                   f"production chunk, convergence-scheduled refinement "
                   f"rounds — racon_tpu/sched/, telemetry in sched_* "

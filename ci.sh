@@ -86,4 +86,7 @@ python scripts/server_smoke.py
 echo "[ci] cache smoke (CAS resubmit = zero dispatches, torn-entry drill, CACHE=0 fallback, byte-diff)"
 python scripts/cache_smoke.py
 
+echo "[ci] job trace smoke (daemon + 2-worker fleet, ctx handoff, mid-shard kill, 3-process timeline + flight dump)"
+python scripts/job_trace_smoke.py
+
 echo "[ci] OK"

@@ -92,6 +92,7 @@ class Context:
                  fault_prefixes: Optional[tuple] = None,
                  span_required: Optional[Dict] = None,
                  span_attr_free: Optional[tuple] = None,
+                 hist_buckets: Optional[Dict] = None,
                  docs_override: Optional[Dict[str, str]] = None):
         self.root = os.path.abspath(root)
         self.files = files if files is not None else \
@@ -106,6 +107,7 @@ class Context:
         self._fault_prefixes = fault_prefixes
         self._span_required = span_required
         self._span_attr_free = span_attr_free
+        self._hist_buckets = hist_buckets
         self._docs_override = docs_override
 
     # ------------------------------------------------------- file access
@@ -233,6 +235,12 @@ class Context:
             return self._fault_prefixes
         from racon_tpu.resilience import faults
         return faults.SITE_PREFIXES
+
+    def hist_buckets(self) -> Dict:
+        if self._hist_buckets is not None:
+            return self._hist_buckets
+        from racon_tpu.obs import metrics
+        return metrics.HIST_BUCKETS
 
     def _span_tables(self):
         """(KIND_REQUIRED_ATTRS, ATTR_FREE_KINDS) parsed statically out

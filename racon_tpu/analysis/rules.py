@@ -12,6 +12,8 @@ machine-readable registry (see docs/ANALYSIS.md for the catalog):
   lock-discipline LCK001        # guarded-by: attrs mutate under lock
   choke-point    CHK001         device_put inside retry.call closures
   determinism    DET001         no wallclock/PRNG in identity paths
+  histogram      HIS001         record_hist <-> HIST_BUCKETS <->
+                                METRIC_SPECS 'hist' rows <-> exporter
 
 Registry-direction checks (dead declarations, doc drift, coverage)
 only run in full-repo mode (``ctx.full``); per-file directions also
@@ -664,6 +666,92 @@ def check_choke_point(ctx: Context) -> Iterator[Finding]:
         yield from walk(tree)
 
 
+# ============================================================= histogram
+
+def _iter_record_hist(tree: ast.Module) -> Iterator[Tuple[int, str]]:
+    """(lineno, family) for every ``record_hist(<literal>, ...)`` call
+    whose family name is statically resolvable."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and \
+                _func_name(node) == "record_hist" and node.args:
+            name = _str_key(node.args[0])
+            if name is not None and "*" not in name:
+                yield node.lineno, name
+
+
+def check_histogram(ctx: Context) -> Iterator[Finding]:
+    buckets = ctx.hist_buckets()
+
+    # HIS001 (per-file direction): every recorded family has declared
+    # bucket bounds — record_hist raises at runtime otherwise, and the
+    # linter catches the site before any test exercises it.
+    for path in ctx.scoped("racon_tpu/", "scripts/", "bench.py"):
+        rel = ctx.rel(path)
+        tree = ctx.tree(path)
+        if tree is None:
+            continue
+        for lineno, name in _iter_record_hist(tree):
+            if ctx.pragma(path, lineno, "hist-ok"):
+                continue
+            if name not in buckets:
+                yield Finding(
+                    "HIS001", "error", rel, lineno,
+                    f"record_hist family {name!r} has no bucket bounds "
+                    f"declared in racon_tpu/obs/metrics.py "
+                    f"HIST_BUCKETS")
+
+    if not ctx.full:
+        return
+
+    metrics_rel = "racon_tpu/obs/metrics.py"
+    metrics_src = ""
+    export_src = ""
+    corpus = []
+    for f in ctx.scoped("racon_tpu/", "bench.py"):
+        rel = ctx.rel(f)
+        if rel == metrics_rel:
+            metrics_src = ctx.source(f)
+        elif rel == "racon_tpu/obs/export.py":
+            export_src = ctx.source(f)
+        corpus.append(ctx.source(f))
+    blob = "\n".join(corpus)
+
+    def bucket_line(name: str) -> int:
+        for i, ln in enumerate(metrics_src.splitlines(), 1):
+            if f'"{name}"' in ln:
+                return i
+        return 1
+
+    # Registry directions: buckets <-> METRIC_SPECS 'hist' rows agree
+    # both ways, and every declared family has a producer somewhere.
+    hist_specs = {s[0] for s in ctx.metric_specs() if s[1] == "hist"}
+    for name in sorted(buckets):
+        if name not in hist_specs:
+            yield Finding(
+                "HIS001", "error", metrics_rel, bucket_line(name),
+                f"HIST_BUCKETS family {name!r} has no METRIC_SPECS "
+                f"row with merge kind 'hist' (fleet aggregation would "
+                f"not fold its buckets)")
+        if f'record_hist("{name}"' not in blob:
+            yield Finding(
+                "HIS001", "error", metrics_rel, bucket_line(name),
+                f"HIST_BUCKETS family {name!r} is recorded nowhere "
+                f"(no record_hist call in racon_tpu/ or bench.py)")
+    for pattern in sorted(hist_specs):
+        if pattern not in buckets:
+            yield Finding(
+                "HIS001", "error", metrics_rel, bucket_line(pattern),
+                f"METRIC_SPECS row {pattern!r} declares merge kind "
+                f"'hist' but HIST_BUCKETS has no bounds for it")
+    if buckets and ('le="' not in export_src or
+                    "_bucket" not in export_src):
+        yield Finding(
+            "HIS001", "error", "racon_tpu/obs/export.py", 1,
+            "histogram families are declared but obs/export.py has no "
+            "OpenMetrics histogram rendering (_bucket samples with le "
+            "labels)")
+
+
 # =========================================================== determinism
 
 _WALLCLOCK = ("time.time", "time.time_ns", "datetime.now",
@@ -757,4 +845,8 @@ ALL_RULES = (
     Rule("determinism", ("DET001",), "error",
          "no wallclock/PRNG in fingerprint, ledger, or checkpoint "
          "paths outside the blessed shims", check_determinism),
+    Rule("histogram", ("HIS001",), "error",
+         "record_hist families, HIST_BUCKETS bounds, METRIC_SPECS "
+         "'hist' rows, and the OpenMetrics exporter agree in every "
+         "direction", check_histogram),
 )

@@ -363,6 +363,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         fleet.install_writer(obs_dir, wid, fp)
         tracer.set_context(worker_id=wid, run_fp=fp)
 
+    if not args.ledger_dir:
+        # Serial runs spawned by another process (tests, orchestration)
+        # adopt its trace context from RACON_TPU_TRACE_CTX; ledger
+        # workers adopt inside run_worker (env first, then ledger meta).
+        from racon_tpu.obs.trace import adopt_trace_context
+        adopt_trace_context(tracer=tracer)
+
     def make_polisher():
         return build_polisher(spec, logger=logger, mesh=mesh)
 
@@ -454,8 +461,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"[racon_tpu::] interrupted (signal {exc.signum})",
                   file=sys.stderr)
         # The eviction contract: a SIGTERM'd worker leaves a *final*
-        # metric snapshot for the fleet aggregator before dying.
-        fleet.flush_final()
+        # metric snapshot (and a flight-recorder dump) for the fleet
+        # aggregator before dying.
+        fleet.flush_final(reason=f"signal-{exc.signum}")
         tracer.finish(metrics=obs_registry().snapshot())
         return 128 + exc.signum
     except Exception as exc:
@@ -471,7 +479,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         out.flush()
         print(f"[racon_tpu::] terminal watchdog breach — {exc}",
               file=sys.stderr)
-        fleet.flush_final()
+        fleet.flush_final(reason="watchdog-terminal")
         tracer.finish(metrics=obs_registry().snapshot())
         return EXIT_SELF_EVICT
     finally:

@@ -60,6 +60,7 @@ from typing import Dict, List, Optional
 from racon_tpu.distributed import ledger as dledger
 from racon_tpu.distributed.ledger import LedgerError, WorkLedger
 from racon_tpu.obs import fleet
+from racon_tpu.obs.trace import ENV_TRACE_CTX, env_trace_ctx, parse_trace_ctx
 from racon_tpu.resilience.faults import ENV_FAULTS
 from racon_tpu.resilience.watchdog import EXIT_SELF_EVICT
 from racon_tpu.utils.atomicio import atomic_write_bytes
@@ -197,6 +198,20 @@ class Autoscaler:
         self.seq = 0
 
     # ---------------------------------------------------------- spawn
+    def _trace_ctx(self) -> str:
+        """The context workers should inherit: the supervisor's own
+        validated RACON_TPU_TRACE_CTX, else whatever the ledger meta
+        publisher stamped ("" when neither exists)."""
+        ctx = env_trace_ctx()
+        if ctx:
+            return ctx
+        try:
+            led = WorkLedger.attach(self.ledger_dir)
+        except LedgerError:
+            return ""
+        meta_ctx = str(led.meta.get("trace_ctx", ""))
+        return meta_ctx if parse_trace_ctx(meta_ctx) else ""
+
     def _spawn(self, reason: str,
                avoid: Optional[List[str]] = None) -> bool:
         if self.spawned >= self.policy.max_spawns:
@@ -218,6 +233,14 @@ class Autoscaler:
             env["RACON_TPU_DIST_AVOID"] = ",".join(avoid)
         else:
             env.pop("RACON_TPU_DIST_AVOID", None)
+        # Trace handoff: supervisor-spawned workers inherit this
+        # process's trace context (own env, else the ledger meta's),
+        # so autoscaled replacements land in the same job timeline.
+        ctx = self._trace_ctx()
+        if ctx:
+            env[ENV_TRACE_CTX] = ctx
+        else:
+            env.pop(ENV_TRACE_CTX, None)
         argv = ([sys.executable, "-m", "racon_tpu.cli"] + self.argv +
                 ["--worker-id", wid])
         os.makedirs(self.logs_dir, exist_ok=True)
@@ -239,7 +262,8 @@ class Autoscaler:
         dledger.append_event(self.ledger_dir, {
             "ev": "spawn", "worker": wid, "reason": reason,
             "pid": proc.pid, **({"faults": spec} if spec else {}),
-            **({"avoid": avoid} if avoid else {})})
+            **({"avoid": avoid} if avoid else {}),
+            **({"trace_ctx": ctx} if ctx else {})})
         print(f"[racon_tpu::autoscale] spawned worker {wid} "
               f"(pid {proc.pid}, {reason})"
               f"{' faults=' + spec if spec else ''}", file=self.log)

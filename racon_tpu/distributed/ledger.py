@@ -293,6 +293,14 @@ class WorkLedger:
             }
             if offsets is not None:
                 meta["target_offsets"] = [int(o) for o in offsets]
+            # Publish the submitting process's trace context (if any)
+            # so late joiners with no RACON_TPU_TRACE_CTX of their own
+            # still adopt the job's trace_id. Published once with the
+            # meta, immutable like everything else in it.
+            from racon_tpu.obs.trace import env_trace_ctx
+            ctx = env_trace_ctx()
+            if ctx:
+                meta["trace_ctx"] = ctx
             blob = (json.dumps(meta, sort_keys=True) + "\n").encode()
             publish_exclusive(path, blob)
             # Winner or not, the published file is the contract.

@@ -300,6 +300,16 @@ def run_worker(*, ledger_dir: str, fingerprint: str,
     fleet.install_writer(os.path.join(ledger_dir, fleet.OBS_SUBDIR),
                          worker, fingerprint)
     get_tracer().set_context(worker_id=worker, run_fp=fingerprint)
+    # Trace adoption: RACON_TPU_TRACE_CTX first (set by the spawning
+    # autoscaler/smoke), else the context the meta publisher stamped
+    # into the ledger — so every worker span joins the submitting
+    # process's trace without any live channel between them. Malformed
+    # or absent contexts degrade to a fresh root trace, never an error.
+    from racon_tpu.obs.trace import adopt_trace_context
+    if adopt_trace_context() is None:
+        meta_ctx = str(ledger.meta.get("trace_ctx", ""))
+        if meta_ctx:
+            adopt_trace_context(meta_ctx)
     poll = _poll_interval(ledger.lease_s)
     avoid = _avoid_shards()
     print(f"[racon_tpu::dist] worker {worker}: joined ledger "

@@ -31,7 +31,8 @@ from __future__ import annotations
 import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
-from racon_tpu.obs.metrics import MERGE_SUM, merge_kind
+from racon_tpu.obs.metrics import (HIST_BUCKETS, MERGE_HIST, MERGE_SUM,
+                                   merge_kind)
 
 PREFIX = "racon_tpu_"
 CONTENT_TYPE = ("application/openmetrics-text; version=1.0.0; "
@@ -94,10 +95,37 @@ class _Family:
         self.samples.append(
             (f"{self.name}{suffix}{_labels(labels)}", _fmt(value)))
 
+    def add_hist(self, labels: List[Tuple[str, str]], hist: Dict,
+                 bounds) -> None:
+        """One histogram series: cumulative ``_bucket`` samples in
+        declared ``le`` order (ending at ``+Inf``), then ``_sum`` and
+        ``_count``. Appended in order — render() keeps histogram
+        samples unsorted because ``le`` values sort numerically, not
+        lexically."""
+        buckets = list(hist.get("buckets", ()))
+        buckets += [0] * (len(bounds) + 1 - len(buckets))
+        cum = 0
+        for i, bound in enumerate(bounds):
+            cum += int(buckets[i])
+            self.samples.append((
+                f"{self.name}_bucket"
+                f"{_labels(labels + [('le', _fmt(float(bound)))])}",
+                _fmt(cum)))
+        cum += int(buckets[len(bounds)])
+        self.samples.append((
+            f"{self.name}_bucket{_labels(labels + [('le', '+Inf')])}",
+            _fmt(cum)))
+        self.samples.append((f"{self.name}_sum{_labels(labels)}",
+                             _fmt(float(hist.get('sum', 0.0)))))
+        self.samples.append((f"{self.name}_count{_labels(labels)}",
+                             _fmt(int(hist.get('count', 0)))))
+
     def render(self, out: List[str]) -> None:
         out.append(f"# HELP {self.name} {self.help}")
         out.append(f"# TYPE {self.name} {self.mtype}")
-        for sample, value in sorted(self.samples):
+        samples = self.samples if self.mtype == "histogram" \
+            else sorted(self.samples)
+        for sample, value in samples:
             out.append(f"{sample} {value}")
 
 
@@ -108,7 +136,10 @@ def _family_for_key(key: str) -> _Family:
         # The sample suffix is appended by _Family.add; a key that
         # already says _total (poa_windows_total) must not double it.
         name = name[:-len("_total")]
-    mtype = "counter" if kind == MERGE_SUM else "gauge"
+    if kind == MERGE_HIST:
+        mtype = "histogram"
+    else:
+        mtype = "counter" if kind == MERGE_SUM else "gauge"
     return _Family(name, mtype,
                    f"racon_tpu metric {key} (merge={kind})")
 
@@ -132,14 +163,18 @@ def render_registry(snapshot: Dict,
     fams: Dict[str, _Family] = {}
     for key in sorted(snapshot):
         value = snapshot[key]
-        if not _numeric(value):
+        is_hist = key in HIST_BUCKETS and isinstance(value, dict)
+        if not is_hist and not _numeric(value):
             continue
         fam = _family_for_key(key)
         if fam.name in fams:
             fam = fams[fam.name]
         else:
             fams[fam.name] = fam
-        fam.add(labels, value)
+        if is_hist:
+            fam.add_hist(labels, value, HIST_BUCKETS[key])
+        else:
+            fam.add(labels, value)
     return _render(list(fams.values()))
 
 
@@ -157,7 +192,9 @@ def render_fleet(model: Dict) -> str:
 
     for key in sorted(model.get("fleet", {})):
         value = model["fleet"][key]
-        if _numeric(value):
+        if key in HIST_BUCKETS and isinstance(value, dict):
+            fam(key).add_hist([], value, HIST_BUCKETS[key])
+        elif _numeric(value):
             fam(key).add([], value)
 
     n = _Family(PREFIX + "fleet_workers", "gauge",
@@ -272,8 +309,9 @@ def validate_openmetrics(text: str) -> List[str]:
     not in the image). Verifies: single trailing ``# EOF``; every
     sample parses as ``name[{labels}] value`` with a finite number;
     every sample's family has TYPE and HELP lines *before* it; counter
-    samples end in ``_total``; families are not interleaved. Returns
-    a list of problems (empty = valid)."""
+    samples end in ``_total``; histogram samples end in ``_bucket`` /
+    ``_sum`` / ``_count`` and buckets carry an ``le`` label; families
+    are not interleaved. Returns a list of problems (empty = valid)."""
     errors: List[str] = []
     lines = text.split("\n")
     if not text.endswith("\n"):
@@ -322,8 +360,13 @@ def validate_openmetrics(text: str) -> List[str]:
         if "{" in head and not head.endswith("}"):
             errors.append(f"malformed labels in: {ln!r}")
         fam = name
-        if fam not in types and fam.endswith("_total"):
-            fam = fam[:-len("_total")]
+        if fam not in types:
+            # Family resolution: counters sample as <fam>_total,
+            # histograms as <fam>_bucket/_sum/_count.
+            for suf in ("_total", "_bucket", "_sum", "_count"):
+                if name.endswith(suf) and name[:-len(suf)] in types:
+                    fam = name[:-len(suf)]
+                    break
         if fam not in types:
             errors.append(f"sample {name!r} has no # TYPE line")
             continue
@@ -332,6 +375,14 @@ def validate_openmetrics(text: str) -> List[str]:
         if types[fam] == "counter" and not name.endswith("_total"):
             errors.append(
                 f"counter sample {name!r} lacks '_total' suffix")
+        if types[fam] == "histogram":
+            suffix = name[len(fam):]
+            if suffix not in ("_bucket", "_sum", "_count"):
+                errors.append(f"histogram sample {name!r} lacks "
+                              f"'_bucket'/'_sum'/'_count' suffix")
+            if suffix == "_bucket" and 'le="' not in head:
+                errors.append(f"histogram bucket {name!r} lacks an "
+                              f"'le' label")
         try:
             float(value)
         except ValueError:

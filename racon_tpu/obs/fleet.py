@@ -234,11 +234,16 @@ def maybe_flush() -> None:
         _WRITER.maybe_flush()
 
 
-def flush_final() -> None:
+def flush_final(reason: str = "teardown") -> None:
     """Final-snapshot hook for exit paths (normal return, SIGTERM
-    teardown). Idempotent; no-op without a writer."""
+    teardown, watchdog self-eviction, unhandled-exception unwinds).
+    Idempotent; no-op without a writer. Also the flight-recorder
+    chokepoint: every abnormal teardown already routes through here,
+    so the ring (obs/flightrec.py) dumps beside the metric shards."""
     if _WRITER is not None:
         _WRITER.flush(final=True)
+        from racon_tpu.obs import flightrec
+        flightrec.dump(_WRITER.directory, reason=reason)
 
 
 # ----------------------------------------------------------- aggregation
@@ -452,4 +457,86 @@ def aggregate(root: str) -> Dict:
         "retires": retires,
         "supervisor": supervisor,
         "stragglers": stragglers,
+    }
+
+
+# ----------------------------------------------------- per-job timelines
+
+
+def _span_matches_trace(span: Dict, trace_id: str) -> bool:
+    """Batch spans carry comma-joined trace ids (one cross-request
+    dispatch serves several jobs); a span belongs to the job when the
+    id appears in the list."""
+    tid = span.get("trace_id")
+    if not isinstance(tid, str):
+        return False
+    return trace_id in tid.split(",")
+
+
+def assemble_job_timeline(root: str, trace_id: str) -> Dict:
+    """Stitch one causal per-job timeline out of every span file under
+    ``root`` (its ``obs/`` subdir for a ledger dir): each process —
+    daemon, ledger workers, autoscaler spawns — writes its own
+    ``RACON_TPU_TRACE`` JSONL, and every span carrying the job's
+    ``trace_id`` (adopted via the ``RACON_TPU_TRACE_CTX`` handoff) is
+    placed on a common wall clock using its trace's ``begin`` header.
+    ``.part`` sidecars count too: a hard-killed worker never promoted
+    its trace, and its spans are exactly the interesting ones.
+
+    Returns ``{"trace_id", "n_processes", "n_spans", "sources": {file:
+    span count}, "spans": [...]}`` with spans sorted by absolute start
+    time (each span gains ``t_abs`` and ``src``). Refuses loudly
+    (:class:`FleetObsError`) when no span carries the id, or when the
+    matched spans straddle different ``run_fp`` stamps — merging two
+    runs' spans would fabricate a timeline that never happened."""
+    obs_dir = obs_dir_for(root)
+    try:
+        names = sorted(os.listdir(obs_dir))
+    except OSError:
+        names = []
+    spans: List[Dict] = []
+    sources: Dict[str, int] = {}
+    fps = set()
+    for name in names:
+        if name.endswith(SHARD_SUFFIX) or not (
+                name.endswith(".jsonl") or name.endswith(".jsonl.part")):
+            continue
+        path = os.path.join(obs_dir, name)
+        records, _ = load_jsonl_prefix(path)
+        if not records or records[0].get("ev") != "begin":
+            continue
+        begin = float(records[0].get("unix_time", 0.0))
+        n = 0
+        for rec in records[1:]:
+            if rec.get("ev") != "span" or \
+                    not _span_matches_trace(rec, trace_id):
+                continue
+            span = dict(rec)
+            span["t_abs"] = round(begin + float(rec.get("t0", 0.0)), 6)
+            span["src"] = name
+            spans.append(span)
+            n += 1
+            fp = rec.get("run_fp")
+            if isinstance(fp, str):
+                fps.add(fp)
+        if n:
+            sources[name] = n
+    if not spans:
+        raise FleetObsError(
+            f"[racon_tpu::fleet] no span under {obs_dir!r} carries "
+            f"trace_id {trace_id!r} — was the job run with tracing on "
+            f"and the trace context handed to every process?")
+    if len(fps) > 1:
+        raise FleetObsError(
+            f"[racon_tpu::fleet] refusing to assemble a timeline from "
+            f"mixed runs: trace_id {trace_id!r} matched spans stamped "
+            f"run_fp {', '.join(sorted(fp[:12] for fp in fps))} — "
+            "stale traces from a previous run share this directory")
+    spans.sort(key=lambda s: (s["t_abs"], s["src"], s.get("id", 0)))
+    return {
+        "trace_id": trace_id,
+        "n_processes": len(sources),
+        "n_spans": len(spans),
+        "sources": sources,
+        "spans": spans,
     }

@@ -40,27 +40,38 @@ already had in hand.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, Optional
 
+from racon_tpu.obs import flightrec as _flightrec
 from racon_tpu.obs import trace as _trace
 
 
 class MetricsRegistry:
     """Flat name -> value store: numeric counters plus JSON-ready
     structured values (lists/dicts). Keys starting with ``_`` are
-    internal and excluded from snapshots."""
+    internal and excluded from snapshots. Mutations of the process
+    registry additionally land in the flight-recorder ring
+    (obs/flightrec.py) so a crash dump shows the final metric deltas;
+    scratch registries stay out of the ring."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self._v: Dict[str, object] = {}   # guarded-by: _lock
 
+    def _flight(self, key: str, value) -> None:
+        if self is _REGISTRY:
+            _flightrec.note_metric(key, value)
+
     def inc(self, key: str, value: float = 1) -> None:
         with self._lock:
             self._v[key] = self._v.get(key, 0) + value
+        self._flight(key, value)
 
     def set(self, key: str, value: object) -> None:
         with self._lock:
             self._v[key] = value
+        self._flight(key, value)
 
     def max(self, key: str, value: float) -> None:
         """Keep the running maximum (gauge peaks, e.g. queue depth)."""
@@ -68,6 +79,7 @@ class MetricsRegistry:
             cur = self._v.get(key)
             if cur is None or value > cur:
                 self._v[key] = value
+        self._flight(key, value)
 
     def apply(self, fn) -> None:
         """Run ``fn(values_dict)`` under the registry lock — the single
@@ -105,6 +117,94 @@ def reset() -> None:
     _REGISTRY.reset()
 
 
+# ------------------------------------------------------------ histograms
+
+#: Fixed-bucket latency histograms: family name -> ascending log-spaced
+#: upper bucket bounds (seconds, ``le`` semantics; one implicit +Inf
+#: overflow bucket rides at the end). The set of families IS the
+#: histogram registry: merge_kind() answers ``hist`` for exactly these
+#: keys, METRIC_SPECS carries one MERGE_HIST row per family, the
+#: OpenMetrics exporter renders each as a ``_bucket``/``_sum``/
+#: ``_count`` family, and the HIS001 lint rule keeps all of that
+#: consistent with the record_hist() call sites.
+HIST_BUCKETS = {
+    "dispatch_round_s": (0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                         0.5, 1.0, 2.5, 5.0, 10.0),
+    "h2d_transfer_s": (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                       0.1, 0.25, 0.5, 1.0, 2.5),
+    "serve_job_latency_s": (0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                            5.0, 10.0, 25.0, 60.0, 120.0),
+    "serve_queue_wait_s": (0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                           1.0, 2.5, 5.0, 10.0, 30.0),
+    "walk_hidden_s": (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                      0.1, 0.25, 0.5, 1.0, 2.5),
+}
+
+
+def record_hist(name: str, value: float,
+                reg: Optional[MetricsRegistry] = None) -> None:
+    """Record one observation into the fixed-bucket histogram ``name``
+    (a :data:`HIST_BUCKETS` family). The registry value is a dict
+    ``{"buckets": [c0, ..., cN, overflow], "sum": s, "count": n}``
+    with non-cumulative per-bucket counts — per-bucket SUM is the fleet
+    merge, and the exporter derives the cumulative ``le`` series."""
+    reg = reg if reg is not None else _REGISTRY
+    bounds = HIST_BUCKETS[name]
+    value = float(value)
+
+    def _mutate(v):
+        h = v.get(name)
+        if h is None:
+            h = v[name] = {"buckets": [0] * (len(bounds) + 1),
+                           "sum": 0.0, "count": 0}
+        idx = len(bounds)
+        for i, bound in enumerate(bounds):
+            if value <= bound:
+                idx = i
+                break
+        h["buckets"][idx] += 1
+        h["sum"] = round(h["sum"] + value, 6)
+        h["count"] += 1
+
+    reg.apply(_mutate)
+    if reg is _REGISTRY:
+        _flightrec.note_metric(name, round(value, 6))
+
+
+def hist_quantile(hist: Dict, q: float, bounds) -> float:
+    """The q-quantile (0..1) estimated from a histogram dict by linear
+    interpolation inside the landing bucket; the overflow bucket clamps
+    to the last finite bound. 0.0 on an empty histogram."""
+    count = int(hist.get("count", 0))
+    if count <= 0:
+        return 0.0
+    target = q * count
+    seen = 0
+    lo = 0.0
+    for i, c in enumerate(hist["buckets"]):
+        hi = float(bounds[i]) if i < len(bounds) else float(bounds[-1])
+        if c and seen + c >= target:
+            frac = (target - seen) / c
+            return round(lo + (hi - lo) * min(max(frac, 0.0), 1.0), 6)
+        seen += c
+        lo = hi
+    return round(float(bounds[-1]), 6)
+
+
+def hist_percentiles(name: str,
+                     reg: Optional[MetricsRegistry] = None
+                     ) -> Dict[str, float]:
+    """``{name_p50, name_p95, name_p99}`` from the recorded buckets;
+    empty when the family has no observations."""
+    reg = reg if reg is not None else _REGISTRY
+    h = reg.get(name, None)
+    if not isinstance(h, dict) or not h.get("count"):
+        return {}
+    bounds = HIST_BUCKETS[name]
+    return {f"{name}_p{p}": hist_quantile(h, p / 100.0, bounds)
+            for p in (50, 95, 99)}
+
+
 # ------------------------------------------------------------- transfers
 
 def record_h2d(nbytes: int, seconds: float,
@@ -116,6 +216,7 @@ def record_h2d(nbytes: int, seconds: float,
     reg.inc("h2d_bytes", int(nbytes))
     reg.inc("h2d_s", float(seconds))
     reg.inc("h2d_transfers")
+    record_hist("h2d_transfer_s", float(seconds), reg)
     _trace.get_tracer().point("transfer", name, dur_s=float(seconds),
                               bytes=int(nbytes), dir="h2d")
 
@@ -221,6 +322,7 @@ def record_watchdog_breach(site: str, deadline_s: float, waited_s: float,
     reg.inc(f"res_watchdog_site_{_site_key(site)}")
     if terminal:
         reg.inc("res_watchdog_terminal_total")
+    _flightrec.note_breach(site, deadline_s, waited_s, terminal)
     _trace.get_tracer().point("watchdog", site, dur_s=float(waited_s),
                               deadline_s=float(deadline_s),
                               waited_s=round(float(waited_s), 6),
@@ -562,6 +664,8 @@ def record_walk(walk_s: float, overlap_s: float, dispatches: int,
     reg.inc("walk_dispatches", int(dispatches))
     reg.inc("walk_fused_chunks", int(fused_chunks))
     reg.max("walk_queue_peak", int(queue_peak))
+    if dispatches:
+        record_hist("walk_hidden_s", float(overlap_s), reg)
     total = float(reg.get("walk_seconds", 0.0))
     if total > 0:
         reg.set("walk_hidden_fraction",
@@ -586,27 +690,40 @@ def walk_extras(reg: Optional[MetricsRegistry] = None
 # ---------------------------------------------------------- serve plane
 
 def record_serve_job(event: str, job: str, tenant: str,
-                     reg: Optional[MetricsRegistry] = None) -> None:
+                     trace_id: str = "-", parent_id: int = 0,
+                     reg: Optional[MetricsRegistry] = None) -> int:
     """Account one daemon job-lifecycle event (racon_tpu/server/):
     ``submitted`` / ``completed`` / ``failed`` / ``cancelled`` /
     ``resumed`` — each lands as the counter ``serve_jobs_<event>``
-    plus a ``serve`` trace span carrying the job id and tenant."""
+    plus a ``serve`` trace span carrying the job id, tenant, and the
+    job's trace context (``"-"``/0 when the caller has none, e.g. the
+    bench driving the batcher directly). Returns the span id — the
+    ``submitted`` span is the root the daemon mints the job's
+    :class:`~racon_tpu.obs.trace.TraceContext` from."""
     reg = reg if reg is not None else _REGISTRY
     reg.inc(f"serve_jobs_{event}")
-    _trace.get_tracer().point("serve", event, job=str(job),
-                              tenant=str(tenant))
+    return _trace.get_tracer().point("serve", event, job=str(job),
+                                     tenant=str(tenant),
+                                     trace_id=str(trace_id),
+                                     parent_id=int(parent_id))
 
 
 def record_serve_batch(n_windows: int, capacity: int, jobs, tenants,
-                       wait_s: float,
+                       wait_s: float, round_s: float = 0.0,
+                       trace_ids=(), parent_ids=(),
                        reg: Optional[MetricsRegistry] = None) -> None:
     """Account one cross-request batch dispatch
     (racon_tpu/server/batch.py): windows carried, the jobs/tenants that
-    contributed, and the summed staging wait its items paid. The
-    derived ``serve_batch_occupancy`` gauge — mean windows per dispatch
-    over the bucket capacity — is the headline: strictly higher under
-    concurrent jobs than one-at-a-time is the server smoke's
-    acceptance gate."""
+    contributed, the summed staging wait its items paid, the dispatch
+    round's wall (``dispatch_round_s`` histogram), and the trace
+    contexts riding the batched items (comma-joined into the span's
+    ``trace_id`` so a mixed batch appears in every contributing job's
+    timeline). The derived ``serve_batch_occupancy`` gauge — mean
+    windows per dispatch over the bucket capacity — is the headline:
+    strictly higher under concurrent jobs than one-at-a-time is the
+    server smoke's acceptance gate. Stamps ``serve_rate_wall_s`` so
+    readers can tell a live gauge from one re-served forever after the
+    final dispatch."""
     reg = reg if reg is not None else _REGISTRY
     cap = max(int(capacity), 1)
 
@@ -620,13 +737,19 @@ def record_serve_batch(n_windows: int, capacity: int, jobs, tenants,
             v.get("serve_tenant_wait_s", 0.0) + float(wait_s)
         v["serve_batch_occupancy"] = round(
             v["serve_batch_windows"] / (v["serve_batches"] * cap), 4)
+        v["serve_rate_wall_s"] = round(time.time(), 3)
 
     reg.apply(_mutate)
+    if round_s > 0:
+        record_hist("dispatch_round_s", float(round_s), reg)
+    tid = ",".join(sorted({str(t) for t in trace_ids if t})) or "-"
+    pid = int(next(iter(parent_ids), 0))
     _trace.get_tracer().point("serve", "batch",
                               job=",".join(str(j) for j in jobs),
                               tenant=",".join(str(t) for t in tenants),
                               windows=int(n_windows), capacity=cap,
-                              wait_s=round(float(wait_s), 6))
+                              wait_s=round(float(wait_s), 6),
+                              trace_id=tid, parent_id=pid)
 
 
 def set_serve_active(n: int,
@@ -640,9 +763,17 @@ def set_serve_active(n: int,
 def set_serve_rate(jobs_per_min: float,
                    reg: Optional[MetricsRegistry] = None) -> None:
     """Set the daemon's completion-rate gauge (completed jobs over
-    daemon uptime minutes; recomputed at each completion)."""
+    daemon uptime minutes; recomputed at each completion) plus the
+    ``serve_rate_wall_s`` freshness stamp: MERGE_LAST gauges re-serve
+    their final value forever, so obs_report flags them stale once the
+    stamp trails the trace end by more than 5x the flush cadence."""
     reg = reg if reg is not None else _REGISTRY
-    reg.set("serve_jobs_per_min", round(float(jobs_per_min), 4))
+
+    def _mutate(v):
+        v["serve_jobs_per_min"] = round(float(jobs_per_min), 4)
+        v["serve_rate_wall_s"] = round(time.time(), 3)
+
+    reg.apply(_mutate)
 
 
 def serve_extras(reg: Optional[MetricsRegistry] = None
@@ -766,9 +897,14 @@ def sched_extras(reg: Optional[MetricsRegistry] = None
 #:   shape, cache population, derived ratios, structured sched
 #:   telemetry); summing them across workers would be meaningless, so
 #:   the most recent worker snapshot wins.
+#: - ``hist`` — fixed-bucket histograms (:data:`HIST_BUCKETS`); the
+#:   fleet value is the per-bucket sum (plus summed sum/count), which
+#:   is exact: bucket bounds are declared per family, so every worker
+#:   bins identically.
 MERGE_SUM = "sum"
 MERGE_MAX = "max"
 MERGE_LAST = "last"
+MERGE_HIST = "hist"
 
 #: Exact keys whose fleet merge is ``last`` (point-in-time gauges).
 #: ``sched_flag_pulls``/``sched_flag_pull_s`` are NOT here — despite
@@ -798,6 +934,9 @@ _MERGE_LAST_KEYS = frozenset({
     # occupancy, completion rate — the serve_* event/window counters
     # sum and serve_queue_depth_peak maxes via its suffix.
     "serve_active_jobs", "serve_batch_occupancy", "serve_jobs_per_min",
+    # Freshness stamp for the two gauges above (set_serve_rate /
+    # record_serve_batch): the latest wall clock wins.
+    "serve_rate_wall_s",
     # Result-cache derived gauge (record_cache above): the hit ratio
     # re-derives from the totals on every event, so the most recent
     # snapshot wins — the cache_* hit/miss/store/evict counters sum.
@@ -812,6 +951,8 @@ def merge_kind(key: str) -> str:
     be added to ``_MERGE_LAST_KEYS`` (or end in ``_peak``) or the fleet
     number is wrong, which tests/test_fleet_obs.py pins for the known
     key set."""
+    if key in HIST_BUCKETS:
+        return MERGE_HIST
     if key in _MERGE_LAST_KEYS:
         return MERGE_LAST
     if key.endswith("_peak"):
@@ -848,9 +989,13 @@ METRIC_SPECS = (
     ("dist_shards", MERGE_LAST, "dist_shards"),
     ("dist_workers", MERGE_LAST, "dist_workers"),
     ("dist_*", MERGE_SUM, "dist_claims"),
+    ("dispatch_round_s", MERGE_HIST, "dispatch_round_s"),
     ("fleet_target_workers", MERGE_LAST, "fleet_target_workers"),
+    ("flight_dump_write_s", MERGE_SUM, "flight_dump_write_s"),
+    ("flight_dumps_total", MERGE_SUM, "flight_dumps_total"),
     ("h2d_bytes", MERGE_SUM, "h2d_bytes"),
     ("h2d_s", MERGE_SUM, "h2d_s"),
+    ("h2d_transfer_s", MERGE_HIST, "h2d_transfer_s"),
     ("h2d_transfers", MERGE_SUM, "h2d_transfers"),
     ("ingest_blocks", MERGE_SUM, "ingest_blocks"),
     ("ingest_bytes_in", MERGE_SUM, "ingest_bytes_in"),
@@ -912,15 +1057,19 @@ METRIC_SPECS = (
     ("serve_batch_occupancy", MERGE_LAST, "serve_batch_occupancy"),
     ("serve_batch_windows", MERGE_SUM, "serve_batch_windows"),
     ("serve_batches", MERGE_SUM, "serve_batches"),
+    ("serve_job_latency_s", MERGE_HIST, "serve_job_latency_s"),
     ("serve_jobs_per_min", MERGE_LAST, "serve_jobs_per_min"),
     ("serve_jobs_*", MERGE_SUM, "serve_jobs_"),
     ("serve_queue_depth_peak", MERGE_MAX, "serve_queue_depth_peak"),
+    ("serve_queue_wait_s", MERGE_HIST, "serve_queue_wait_s"),
+    ("serve_rate_wall_s", MERGE_LAST, "serve_rate_wall_s"),
     ("serve_tenant_wait_s", MERGE_SUM, "serve_tenant_wait_s"),
     ("walk_async_enabled", MERGE_LAST, "walk_async_enabled"),
     ("walk_chain_len", MERGE_LAST, "walk_chain_len"),
     ("walk_dispatches", MERGE_SUM, "walk_dispatches"),
     ("walk_fused_chunks", MERGE_SUM, "walk_fused_chunks"),
     ("walk_hidden_fraction", MERGE_LAST, "walk_hidden_fraction"),
+    ("walk_hidden_s", MERGE_HIST, "walk_hidden_s"),
     ("walk_overlap_s", MERGE_SUM, "walk_overlap_s"),
     ("walk_queue_peak", MERGE_MAX, "walk_queue_peak"),
     ("walk_seconds", MERGE_SUM, "walk_seconds"),
@@ -930,11 +1079,23 @@ METRIC_SPECS = (
 def merge_values(key: str, values) -> object:
     """Fold per-worker values for ``key`` by its merge kind. Non-numeric
     values (sched hist dicts, fraction lists) always take the last —
-    there is no meaningful sum/max for them."""
+    there is no meaningful sum/max for them — except histogram dicts,
+    which fold per-bucket."""
     vals = [v for v in values if v is not None]
     if not vals:
         return None
     kind = merge_kind(key)
+    if kind == MERGE_HIST:
+        n = len(HIST_BUCKETS[key]) + 1
+        out = {"buckets": [0] * n, "sum": 0.0, "count": 0}
+        for v in vals:
+            if not isinstance(v, dict):
+                continue
+            for i, c in enumerate(v.get("buckets", ())[:n]):
+                out["buckets"][i] += int(c)
+            out["sum"] = round(out["sum"] + float(v.get("sum", 0.0)), 6)
+            out["count"] += int(v.get("count", 0))
+        return out
     numeric = all(isinstance(v, (int, float)) and
                   not isinstance(v, bool) for v in vals)
     if not numeric or kind == MERGE_LAST:

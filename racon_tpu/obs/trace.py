@@ -39,6 +39,86 @@ SCHEMA_VERSION = 1
 
 ENV_TRACE = "RACON_TPU_TRACE"
 ENV_XPROF = "RACON_TPU_TRACE_XPROF"
+ENV_TRACE_CTX = "RACON_TPU_TRACE_CTX"
+
+# How many hex chars of the JobSpec fingerprint become the trace id.
+TRACE_ID_LEN = 16
+
+
+class TraceContext:
+    """Cross-process trace correlation: ``trace_id`` names the job (a
+    prefix of the JobSpec fingerprint, so every process that polishes
+    the same job derives the same id) and ``parent_id`` is the span id,
+    in the minting process, that causally precedes the handoff. The
+    encoded form (``"<trace_id>:<parent_id>"``) rides the
+    ``RACON_TPU_TRACE_CTX`` environment variable and the ledger's
+    ``meta.json``; :func:`parse_trace_ctx` treats anything malformed as
+    absent, so a garbled handoff degrades to a fresh root trace instead
+    of crashing the worker."""
+
+    __slots__ = ("trace_id", "parent_id")
+
+    def __init__(self, trace_id: str, parent_id: int):
+        self.trace_id = trace_id
+        self.parent_id = parent_id
+
+    def encode(self) -> str:
+        return f"{self.trace_id}:{self.parent_id}"
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"TraceContext({self.trace_id!r}, {self.parent_id})"
+
+    def __eq__(self, other) -> bool:
+        return (isinstance(other, TraceContext)
+                and other.trace_id == self.trace_id
+                and other.parent_id == self.parent_id)
+
+
+def mint_trace_context(fingerprint: str, parent_id: int = 0) -> TraceContext:
+    """Derive a job's trace context from its run fingerprint and the
+    span that minted it (the daemon's ``serve submitted`` point)."""
+    return TraceContext(str(fingerprint)[:TRACE_ID_LEN], int(parent_id))
+
+
+def parse_trace_ctx(text) -> Optional[TraceContext]:
+    """Decode ``"<trace_id>:<parent_id>"``; None on anything malformed
+    (empty, missing separator, non-integer parent, blank id)."""
+    if not text or not isinstance(text, str):
+        return None
+    head, sep, tail = text.strip().partition(":")
+    if not sep or not head:
+        return None
+    try:
+        parent = int(tail)
+    except ValueError:
+        return None
+    return TraceContext(head, parent)
+
+
+def env_trace_ctx() -> str:
+    """The raw (already-validated) encoded context from the
+    environment, or "" — the ledger stores this string verbatim in
+    meta.json so late-joining workers can adopt it."""
+    ctx = parse_trace_ctx(envspec.read(ENV_TRACE_CTX))
+    return ctx.encode() if ctx is not None else ""
+
+
+def adopt_trace_context(encoded=None, tracer=None) -> Optional[TraceContext]:
+    """Adopt a handed-off trace context into the process tracer's
+    span context. ``encoded=None`` reads ``RACON_TPU_TRACE_CTX``.
+    Malformed or absent input is NOT an error: the process keeps a
+    fresh root trace (returns None, sets nothing). Never raises."""
+    if encoded is None:
+        try:
+            encoded = envspec.read(ENV_TRACE_CTX)
+        except Exception:
+            return None
+    ctx = parse_trace_ctx(encoded)
+    if ctx is None:
+        return None
+    tr = tracer if tracer is not None else get_tracer()
+    tr.set_context(trace_id=ctx.trace_id, parent_id=ctx.parent_id)
+    return ctx
 
 
 class _NullSpan:
@@ -70,12 +150,12 @@ class NullTracer:
         return _NULL_SPAN
 
     def emit(self, kind: str, name: str, t0_perf: float, dur_s: float,
-             **attrs) -> None:
-        pass
+             **attrs) -> int:
+        return 0
 
     def point(self, kind: str, name: str, dur_s: float = 0.0,
-              **attrs) -> None:
-        pass
+              **attrs) -> int:
+        return 0
 
     def set_context(self, **attrs) -> None:
         pass
@@ -150,7 +230,9 @@ class Tracer:
         self._t0 = time.perf_counter()
         self._lock = threading.Lock()
         self._local = threading.local()
-        self._next_id = 0                 # guarded-by: _lock
+        # Ids start at 1: a TraceContext's parent_id of 0 means "no
+        # parent span" (fresh root), so no real span may claim it.
+        self._next_id = 1                 # guarded-by: _lock
         # Process-wide span attributes (worker_id/shard/run_fp) merged
         # into every span record; explicit span attrs win on key clash.
         self._context: dict = {}          # guarded-by: _lock
@@ -175,6 +257,9 @@ class Tracer:
 
     def _write(self, obj: dict) -> None:
         line = json.dumps(obj, separators=(",", ":"))
+        if obj.get("ev") == "span":
+            from racon_tpu.obs import flightrec
+            flightrec.note_span(obj)
         with self._lock:
             if self._fh is None:
                 return
@@ -209,10 +294,11 @@ class Tracer:
         return _Span(self, kind, name, attrs)
 
     def emit(self, kind: str, name: str, t0_perf: float, dur_s: float,
-             **attrs) -> None:
+             **attrs) -> int:
         """Record a span that already ran, from its own perf_counter
         start (utils/logger.py phases use this: the logger only learns
-        the phase name when the phase ends)."""
+        the phase name when the phase ends). Returns the span id so
+        callers can mint a :class:`TraceContext` parented on it."""
         st = self._stack()
         parent = st[-1].id if st else None
         with self._lock:
@@ -223,12 +309,14 @@ class Tracer:
                      "t0": round(max(t0_perf - self._t0, 0.0), 6),
                      "dur_s": round(max(dur_s, 0.0), 6),
                      **self._context, **attrs})
+        return sid
 
     def point(self, kind: str, name: str, dur_s: float = 0.0,
-              **attrs) -> None:
+              **attrs) -> int:
         """Record an instantaneous-ish event (e.g. one transfer) ending
-        now, with ``dur_s`` of lead time."""
-        self.emit(kind, name, time.perf_counter() - dur_s, dur_s, **attrs)
+        now, with ``dur_s`` of lead time. Returns the span id."""
+        return self.emit(kind, name, time.perf_counter() - dur_s, dur_s,
+                         **attrs)
 
     def set_context(self, **attrs) -> None:
         """Merge process-wide attributes (``worker_id``/``shard``/

@@ -104,7 +104,7 @@ SITES = (
     "dist/shard", "dist/split",
     "h2d/align", "h2d/chunk", "h2d/repack",
     "io/inflate", "io/read",
-    "obs/snapshot",
+    "obs/flight", "obs/snapshot",
     "sched/flags",
     "serve/commit", "serve/dispatch", "serve/submit",
 )

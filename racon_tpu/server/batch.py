@@ -89,9 +89,10 @@ def queue_capacity() -> int:
 
 class _WorkItem:
     __slots__ = ("job_id", "tenant", "windows", "enq_t", "done",
-                 "error", "polished")
+                 "error", "polished", "trace")
 
-    def __init__(self, job_id: str, tenant: str, windows: List):
+    def __init__(self, job_id: str, tenant: str, windows: List,
+                 trace=None):
         self.job_id = job_id
         self.tenant = tenant
         self.windows = windows
@@ -99,6 +100,9 @@ class _WorkItem:
         self.done = threading.Event()
         self.error: Optional[BaseException] = None
         self.polished = 0
+        #: Trace-context rider (obs/trace.TraceContext or None): the
+        #: dispatch span names every trace it served.
+        self.trace = trace
 
 
 class CrossRequestBatcher:
@@ -147,7 +151,8 @@ class CrossRequestBatcher:
 
     # ----------------------------------------------------------- job side
 
-    def consensus(self, job_id: str, tenant: str, windows: List) -> int:
+    def consensus(self, job_id: str, tenant: str, windows: List,
+                  trace=None) -> int:
         """Blockingly run consensus for one job's window chunk through
         the shared batch stream; returns the number polished. Raises
         :class:`ServeError` if the dispatch carrying any slice failed.
@@ -177,7 +182,7 @@ class CrossRequestBatcher:
             if not pending:
                 return n_memo
         items = [_WorkItem(job_id, tenant,
-                           pending[s:s + self.capacity])
+                           pending[s:s + self.capacity], trace=trace)
                  for s in range(0, len(pending), self.capacity)]
         for it in items:
             self._admit.put(it)  # blocks at capacity: admission control
@@ -257,6 +262,7 @@ class CrossRequestBatcher:
         # Forward-plane cell volume drives the deadline, same model as
         # the engine's own dispatch class (ops/budget.py).
         cells = sum(len(w) * (w.n_layers + 1) for w in windows)
+        t0 = time.perf_counter()
         try:
             maybe_fault("serve/dispatch")
             guard("serve/dispatch", dispatch_deadline_s(cells),
@@ -273,7 +279,10 @@ class CrossRequestBatcher:
         record_serve_batch(
             n_windows=len(windows), capacity=self.capacity,
             jobs=sorted({it.job_id for it in batch}),
-            tenants=sorted({it.tenant for it in batch}), wait_s=wait_s)
+            tenants=sorted({it.tenant for it in batch}), wait_s=wait_s,
+            round_s=time.perf_counter() - t0,
+            trace_ids=[it.trace.trace_id for it in batch if it.trace],
+            parent_ids=[it.trace.parent_id for it in batch if it.trace])
 
     def _run(self) -> None:
         closed = False
@@ -313,14 +322,15 @@ class BatchedEngineProxy:
     Polisher cannot tell it is sharing the chip."""
 
     def __init__(self, batcher: CrossRequestBatcher, job_id: str,
-                 tenant: str):
+                 tenant: str, trace=None):
         self._batcher = batcher
         self._job_id = job_id
         self._tenant = tenant
+        self._trace = trace
 
     def consensus_windows(self, windows: List) -> int:
         return self._batcher.consensus(self._job_id, self._tenant,
-                                       windows)
+                                       windows, trace=self._trace)
 
     def __getattr__(self, name: str):
         return getattr(self._batcher.engine, name)

@@ -105,6 +105,7 @@ class PolishServer:
         rebuilt from their stores so /stream keeps serving the exact
         pre-restart bytes. Returns the number of jobs resumed."""
         from racon_tpu.obs.metrics import record_serve_job
+        from racon_tpu.obs.trace import mint_trace_context
         resumed = 0
         for job in scan(self.jobs_root):
             with self._lock:
@@ -115,8 +116,19 @@ class PolishServer:
                     rebuild_result(job)
                 continue
             job.state = "queued"
+            job.t_submit = time.perf_counter()
+            # Pre-trace journals (or a torn one) get a fresh root
+            # context; jobs journaled with one keep their trace_id so
+            # the post-restart spans join the same timeline.
+            sid = record_serve_job(
+                "resumed", job.id, job.tenant,
+                trace_id=job.trace.trace_id if job.trace
+                else mint_trace_context(job.spec.fingerprint()).trace_id,
+                parent_id=job.trace.parent_id if job.trace else 0)
+            if job.trace is None:
+                job.trace = mint_trace_context(job.spec.fingerprint(),
+                                               parent_id=sid)
             job.persist()
-            record_serve_job("resumed", job.id, job.tenant)
             resumed += 1
             self._launch(job)
         self._update_gauges()
@@ -145,6 +157,7 @@ class PolishServer:
 
     def submit(self, tenant: str, spec: JobSpec) -> Job:
         from racon_tpu.obs.metrics import record_serve_job
+        from racon_tpu.obs.trace import mint_trace_context
         from racon_tpu.resilience.faults import maybe_fault
         maybe_fault("serve/submit")
         with self._lock:
@@ -157,10 +170,17 @@ class PolishServer:
             os.makedirs(directory, exist_ok=True)
             job = Job(job_id, str(tenant), spec, directory)
             self._jobs[job_id] = job
+        # The "submitted" point is the job's root span: its trace_id is
+        # the spec fingerprint prefix, and its span id becomes the
+        # parent of every downstream span (this process or spawned).
+        ctx = mint_trace_context(spec.fingerprint())
+        sid = record_serve_job("submitted", job.id, job.tenant,
+                               trace_id=ctx.trace_id)
+        job.trace = mint_trace_context(spec.fingerprint(), parent_id=sid)
+        job.t_submit = time.perf_counter()
         # Journaled BEFORE the submit response: a daemon killed right
         # after replying still knows about the job on restart.
         job.persist()
-        record_serve_job("submitted", job.id, job.tenant)
         self._update_gauges()
         self._launch(job)
         return job
@@ -217,8 +237,12 @@ class PolishServer:
             return b
 
     def _run_job(self, job: Job) -> None:
+        from racon_tpu.obs.metrics import record_hist
         from racon_tpu.resilience.faults import maybe_fault
         with self._sem:
+            if job.t_submit:
+                record_hist("serve_queue_wait_s",
+                            time.perf_counter() - job.t_submit)
             if job.cancel.is_set():
                 self._finish(job, "cancelled", None)
                 return
@@ -252,7 +276,8 @@ class PolishServer:
                     self._finish(job, "done", None)
                     return
             proxy = BatchedEngineProxy(self._batcher_for(job.spec),
-                                       job.id, job.tenant)
+                                       job.id, job.tenant,
+                                       trace=job.trace)
 
             def before_commit(tid, rec):
                 if job.cancel.is_set():
@@ -298,15 +323,20 @@ class PolishServer:
             self._finish(job, state, error)
 
     def _finish(self, job: Job, state: str, error: Optional[str]) -> None:
-        from racon_tpu.obs.metrics import record_serve_job
+        from racon_tpu.obs.metrics import record_hist, record_serve_job
         job.state = state
         job.error = error
         job.persist()
         if state == "done":
             with self._lock:
                 self._n_done += 1
+        if job.t_submit:
+            record_hist("serve_job_latency_s",
+                        time.perf_counter() - job.t_submit)
         record_serve_job("completed" if state == "done" else state,
-                         job.id, job.tenant)
+                         job.id, job.tenant,
+                         trace_id=job.trace.trace_id if job.trace else "-",
+                         parent_id=job.trace.parent_id if job.trace else 0)
         self._update_gauges()
         # Last: anyone woken by the event sees the journal, metrics,
         # and gauges already final.
@@ -476,6 +506,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     print("[racon_tpu::serve] draining...", file=sys.stderr)
     httpd.shutdown()
     clean = server.drain()
+    # Flight recorder dump (obs/flightrec.py): lands beside the fleet
+    # obs dir when RACON_TPU_OBS_DIR is set, else a silent no-op.
+    from racon_tpu.obs import flightrec
+    flightrec.dump(reason="daemon-drain")
     tracer.finish(metrics=registry().snapshot())
     if not clean:
         print("[racon_tpu::serve] drain grace expired with jobs "

@@ -27,6 +27,7 @@ import os
 import threading
 from typing import Dict, List, Optional
 
+from racon_tpu.obs.trace import TraceContext, parse_trace_ctx
 from racon_tpu.server.engine import JobSpec
 from racon_tpu.utils.atomicio import atomic_write_text
 
@@ -49,11 +50,13 @@ class Job:
     appends are atomic), so HTTP streamers snapshot it lock-free."""
 
     __slots__ = ("id", "tenant", "spec", "directory", "state", "error",
-                 "chunks", "cancel", "finished", "n_committed")
+                 "chunks", "cancel", "finished", "n_committed",
+                 "trace", "t_submit")
 
     def __init__(self, job_id: str, tenant: str, spec: JobSpec,
                  directory: str, state: str = "queued",
-                 error: Optional[str] = None):
+                 error: Optional[str] = None,
+                 trace: Optional[TraceContext] = None):
         self.id = job_id
         self.tenant = tenant
         self.spec = spec
@@ -64,6 +67,10 @@ class Job:
         self.cancel = threading.Event()
         self.finished = threading.Event()
         self.n_committed = 0
+        #: Job-scoped trace context (obs/trace.py), minted at submit and
+        #: journaled so a restarted daemon keeps the job's trace_id.
+        self.trace = trace
+        self.t_submit = 0.0
 
     @property
     def ckpt_dir(self) -> str:
@@ -85,7 +92,8 @@ class Job:
         """Atomically rewrite the journal record (state transition)."""
         record = {"schema": SCHEMA, "id": self.id,
                   "tenant": self.tenant, "state": self.state,
-                  "error": self.error, "spec": self.spec.as_dict()}
+                  "error": self.error, "spec": self.spec.as_dict(),
+                  "trace": self.trace.encode() if self.trace else ""}
         atomic_write_text(os.path.join(self.directory, JOB_FILE),
                           json.dumps(record, sort_keys=True) + "\n")
 
@@ -101,14 +109,16 @@ class Job:
         return cls(str(record["id"]), str(record["tenant"]),
                    JobSpec.from_dict(record["spec"]), directory,
                    state=str(record["state"]),
-                   error=record.get("error"))
+                   error=record.get("error"),
+                   trace=parse_trace_ctx(str(record.get("trace", ""))))
 
     def status(self) -> Dict[str, object]:
         """JSON-ready view for the HTTP status endpoints."""
         return {"id": self.id, "tenant": self.tenant,
                 "state": self.state, "error": self.error,
                 "committed": self.n_committed,
-                "bytes": sum(len(c) for c in list(self.chunks))}
+                "bytes": sum(len(c) for c in list(self.chunks)),
+                "trace": self.trace.encode() if self.trace else ""}
 
 
 # ------------------------------------------------------------ directory

@@ -134,6 +134,8 @@ declare("RACON_TPU_BENCH_OUT", "", "path", "OBSERVABILITY.md",
         "bench.py JSON results output path")
 declare("RACON_TPU_DP_TIMEOUT", "600", "float", "OBSERVABILITY.md",
         "per-point timeout for scripts/dp_scaling_bench.py workers")
+declare("RACON_TPU_FLIGHT_EVENTS", "", "int", "OBSERVABILITY.md",
+        "flight recorder ring capacity (default 256; 0 disables)")
 declare("RACON_TPU_JAX_CACHE", "", "path", "OBSERVABILITY.md",
         "persistent jax compilation cache dir (warm-start reuse)")
 declare("RACON_TPU_METRICS_PORT", "", "int", "OBSERVABILITY.md",
@@ -146,6 +148,8 @@ declare("RACON_TPU_TIMING", "", "flag", "OBSERVABILITY.md",
         "verbose per-round timing (separate dispatch per round)")
 declare("RACON_TPU_TRACE", "", "path", "OBSERVABILITY.md",
         "span trace output directory (JSONL tracer gate)")
+declare("RACON_TPU_TRACE_CTX", "", "str", "OBSERVABILITY.md",
+        "inherited trace context handoff (trace_id:parent_span_id)")
 declare("RACON_TPU_TRACE_XPROF", "", "flag", "OBSERVABILITY.md",
         "also capture an xprof/jax profiler trace alongside spans")
 

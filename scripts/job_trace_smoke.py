@@ -1,0 +1,291 @@
+"""CI smoke: end-to-end job telemetry through real processes
+(obs/trace.py, obs/metrics.py histograms, obs/flightrec.py,
+docs/OBSERVABILITY.md "Cross-process trace propagation").
+
+The drill: one job's trace context crosses three processes —
+
+  daemon    mints the context at submit (trace id = spec fingerprint
+            prefix, parent = the submit span), journals it, serves the
+            job, exports latency histograms on /metrics, dumps its
+            flight ring on drain;
+  worker A  inherits the context via ``RACON_TPU_TRACE_CTX``, is
+            SIGTERM'd mid-shard (``dist/contig:1!term``) — the
+            teardown must leave a flight-recorder dump beside its
+            final metric snapshot;
+  worker B  same context, ``skew=99999``: steals A's shard, finishes,
+            merges byte-identically to a telemetry-off serial run.
+
+Gates:
+- the daemon job's status carries a well-formed trace context and its
+  /metrics export passes the OpenMetrics validator WITH histogram
+  samples (``serve_job_latency_s_bucket``/``_count``);
+- telemetry changes no bytes: daemon stream == fleet merge == serial
+  CLI run with tracing/obs/handoff all unset;
+- ``obs_report.py <ledger> --job <trace_id>`` stitches one timeline
+  from >= 3 per-process trace files;
+- the killed worker's flight dump loads and renders in that report
+  (reason ``signal-15``);
+- the fleet OpenMetrics render validates with the folded histogram
+  series.
+"""
+
+import io
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np                                   # noqa: E402
+
+BASES = np.frombuffer(b"ACGT", np.uint8)
+BOOT = ("import sys; from racon_tpu import cli; "
+        "sys.exit(cli.main(sys.argv[1:]))")
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+N_CONTIGS = 6
+N_SHARDS = 3
+
+TELEMETRY_ENVS = ("RACON_TPU_FAULTS", "RACON_TPU_TRACE",
+                  "RACON_TPU_TRACE_CTX", "RACON_TPU_OBS_DIR",
+                  "RACON_TPU_OBS_FLUSH_S", "RACON_TPU_FLIGHT_EVENTS")
+
+
+def _noisy(rng, truth):
+    out = []
+    for b in truth:
+        r = rng.random()
+        if r < 0.03:
+            continue
+        out.append(int(rng.integers(0, 4)) if r < 0.06 else int(
+            np.searchsorted(BASES, b)))
+    return bytes(BASES[np.array(out)])
+
+
+def _write_inputs(d):
+    rng = np.random.default_rng(31)
+    drafts, reads, paf = [], [], []
+    for c in range(N_CONTIGS):
+        truth = BASES[rng.integers(0, 4, 300 + 30 * c)]
+        draft = _noisy(rng, truth)
+        drafts.append(b">c%d\n%s\n" % (c, draft))
+        for i in range(6):
+            r = _noisy(rng, truth)
+            rid = f"r{c}_{i}"
+            reads.append(b">%s\n%s\n" % (rid.encode(), r))
+            paf.append(f"{rid}\t{len(r)}\t0\t{len(r)}\t+\tc{c}"
+                       f"\t{len(draft)}\t0\t{len(draft)}"
+                       f"\t{min(len(r), len(draft))}"
+                       f"\t{max(len(r), len(draft))}\t60")
+    with open(os.path.join(d, "draft.fasta"), "wb") as fh:
+        fh.write(b"".join(drafts))
+    with open(os.path.join(d, "reads.fasta"), "wb") as fh:
+        fh.write(b"".join(reads))
+    with open(os.path.join(d, "ovl.paf"), "w") as fh:
+        fh.write("\n".join(paf) + "\n")
+
+
+def _cmd(d, *extra):
+    return [sys.executable, "-c", BOOT, "--backend", "jax", *extra,
+            os.path.join(d, "reads.fasta"), os.path.join(d, "ovl.paf"),
+            os.path.join(d, "draft.fasta")]
+
+
+def _env(**overrides):
+    e = dict(os.environ)
+    for k in TELEMETRY_ENVS:
+        e.pop(k, None)
+    e.update(overrides)
+    return e
+
+
+# ------------------------------------------------------------ daemon ops
+
+
+def _start_daemon(state, env=None):
+    e = _env(**(env or {}))
+    os.makedirs(state, exist_ok=True)
+    port_file = os.path.join(state, "port")
+    if os.path.exists(port_file):
+        os.remove(port_file)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "racon_tpu.server", "--state-dir", state,
+         "--port", "0"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, env=e,
+        cwd=ROOT)
+    deadline = time.monotonic() + 180
+    while not os.path.exists(port_file):
+        if proc.poll() is not None:
+            raise AssertionError("daemon died on startup:\n" +
+                                 proc.stderr.read().decode())
+        if time.monotonic() > deadline:
+            proc.kill()
+            raise AssertionError("daemon never published its port")
+        time.sleep(0.05)
+    with open(port_file) as fh:
+        port = int(fh.read().strip())
+    return proc, port
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30) as resp:
+        return resp.read()
+
+
+def _submit(port, tenant, d):
+    body = json.dumps({
+        "tenant": tenant,
+        "sequences": os.path.join(d, "reads.fasta"),
+        "overlaps": os.path.join(d, "ovl.paf"),
+        "targets": os.path.join(d, "draft.fasta"),
+        "options": {"backend": "jax"}}).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/jobs", data=body, method="POST")
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return json.loads(resp.read())["id"]
+
+
+def _wait_done(port, job_id, timeout_s=300):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        status = json.loads(_get(port, f"/v1/jobs/{job_id}"))
+        if status["state"] in ("done", "failed", "cancelled"):
+            assert status["state"] == "done", status
+            return status
+        time.sleep(0.2)
+    raise AssertionError(f"job {job_id} did not finish in {timeout_s}s")
+
+
+def main():
+    from racon_tpu.obs import export as obs_export
+    from racon_tpu.obs import fleet as obs_fleet
+    from racon_tpu.obs import flightrec
+    from racon_tpu.obs.trace import TRACE_ID_LEN, parse_trace_ctx
+
+    with tempfile.TemporaryDirectory() as d:
+        _write_inputs(d)
+        ledger = os.path.join(d, "ledger")
+        obs_dir = os.path.join(ledger, obs_fleet.OBS_SUBDIR)
+        os.makedirs(obs_dir)
+
+        # Telemetry-off baseline: the bytes every telemetry-on path
+        # below must still emit.
+        proc = subprocess.run(_cmd(d), capture_output=True, env=_env())
+        assert proc.returncode == 0, proc.stderr.decode()
+        base = proc.stdout
+        assert base.count(b">") == N_CONTIGS
+
+        # --- leg 1: the daemon mints the context and exports
+        # histograms.
+        proc, port = _start_daemon(os.path.join(d, "state"), env={
+            "RACON_TPU_TRACE": os.path.join(obs_dir, "daemon.jsonl"),
+            "RACON_TPU_OBS_DIR": obs_dir})
+        jid = _submit(port, "acme", d)
+        status = _wait_done(port, jid)
+        ctx = parse_trace_ctx(status.get("trace", ""))
+        assert ctx is not None, f"job status has no trace ctx: {status}"
+        assert len(ctx.trace_id) == TRACE_ID_LEN
+        assert ctx.parent_id > 0, \
+            "submit span id must parent the job's downstream spans"
+        assert _get(port, f"/v1/jobs/{jid}/stream") == base, \
+            "daemon stream differs from telemetry-off serial CLI"
+        metrics_text = _get(port, "/metrics").decode()
+        errs = obs_export.validate_openmetrics(metrics_text)
+        assert not errs, "invalid /metrics:\n" + "\n".join(errs)
+        for needle in ("racon_tpu_serve_job_latency_s_bucket{le=",
+                       "racon_tpu_serve_job_latency_s_count 1",
+                       "racon_tpu_serve_queue_wait_s_count 1"):
+            assert needle in metrics_text, \
+                f"missing histogram sample {needle!r}:\n{metrics_text}"
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=120)
+        assert rc == 0, proc.stderr.read().decode()
+        daemon_flight = flightrec.flight_path(obs_dir, proc.pid)
+        assert os.path.exists(daemon_flight), \
+            "daemon drain left no flight dump"
+        assert flightrec.load_flight(daemon_flight)["header"][
+            "reason"] == "daemon-drain"
+        print(f"[job-trace-smoke] daemon: ctx {ctx.encode()} minted, "
+              f"stream byte-identical, histograms on /metrics, flight "
+              f"dump on drain", flush=True)
+
+        # --- leg 2: the handoff. Two ledger workers inherit the
+        # daemon job's context through RACON_TPU_TRACE_CTX (the same
+        # edge the autoscaler hands its spawns); A dies mid-shard.
+        def _worker(wid, *, faults):
+            return subprocess.Popen(
+                _cmd(d, "--ledger-dir", ledger, "--workers", "2",
+                     "--worker-id", wid),
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                env=_env(**{
+                    "RACON_TPU_DIST_SHARDS": str(N_SHARDS),
+                    "RACON_TPU_OBS_FLUSH_S": "0",
+                    "RACON_TPU_TRACE_CTX": ctx.encode(),
+                    "RACON_TPU_TRACE": os.path.join(
+                        obs_dir, f"worker_{wid}.trace.jsonl"),
+                    "RACON_TPU_FAULTS": faults}))
+
+        a = _worker("A", faults="dist/contig:1!term")
+        a_out, a_err = a.communicate(timeout=300)
+        assert a.returncode == 143, \
+            f"A: expected SIGTERM exit 143, got {a.returncode}: " \
+            f"{a_err.decode()}"
+        a_flight = flightrec.flight_path(obs_dir, a.pid)
+        assert os.path.exists(a_flight), \
+            f"killed worker left no flight dump in {obs_dir}"
+        rec = flightrec.load_flight(a_flight)
+        assert rec["header"]["reason"] == "signal-15", rec["header"]
+        assert rec["events"], "flight ring empty at the kill"
+        print(f"[job-trace-smoke] worker A SIGTERM'd mid-shard; flight "
+              f"dump holds {len(rec['events'])} event(s)", flush=True)
+
+        b = _worker("B", faults="skew=99999")
+        b_out, b_err = b.communicate(timeout=300)
+        assert b.returncode == 0, b_err.decode()
+        assert b_out == base, \
+            "fleet merge differs from telemetry-off serial run"
+
+        # --- leg 3: one causal timeline across all three processes.
+        tl = obs_fleet.assemble_job_timeline(ledger, ctx.trace_id)
+        assert tl["n_processes"] >= 3, tl["sources"]
+        assert "daemon.jsonl" in tl["sources"], tl["sources"]
+        assert any(s.startswith("worker_A") for s in tl["sources"])
+        assert any(s.startswith("worker_B") for s in tl["sources"])
+        from scripts import obs_report
+        buf = io.StringIO()
+        assert obs_report._render_job(ledger, ctx.trace_id,
+                                      out=buf) == 0
+        text = buf.getvalue()
+        m = re.search(r"across (\d+) process", text)
+        assert m and int(m.group(1)) >= 3, text
+        assert "reason=signal-15" in text, \
+            "killed worker's flight dump not rendered:\n" + text
+        print(f"[job-trace-smoke] timeline: {tl['n_spans']} span(s) "
+              f"across {tl['n_processes']} processes "
+              f"({', '.join(sorted(tl['sources']))})", flush=True)
+
+        # --- leg 4: fleet fold still validates with histograms in it.
+        model = obs_fleet.aggregate(ledger)
+        fleet_text = obs_export.render_fleet(model)
+        errs = obs_export.validate_openmetrics(fleet_text)
+        assert not errs, "invalid fleet render:\n" + "\n".join(errs)
+        hist_families = [k for k, v in model["fleet"].items()
+                        if isinstance(v, dict) and "buckets" in v]
+        assert hist_families, \
+            "no histogram family survived the fleet merge"
+        print(f"[job-trace-smoke] fleet OpenMetrics valid; folded "
+              f"histograms: {', '.join(sorted(hist_families))}",
+              flush=True)
+
+    print("[job-trace-smoke] PASS", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -66,8 +66,10 @@ KIND_REQUIRED_ATTRS = {
     # One serve-plane event (racon_tpu/server/, obs/metrics.py): a job
     # lifecycle transition (submitted/resumed/completed/...) or a
     # cross-request batch dispatch; job/tenant are comma-joined lists
-    # on batch points so one dispatch names every rider.
-    "serve": ("job", "tenant"),
+    # on batch points so one dispatch names every rider. trace_id /
+    # parent_id tie the point into its job's cross-process timeline
+    # ("-" / 0 when the caller has no context).
+    "serve": ("job", "tenant", "trace_id", "parent_id"),
     # One result-cache event (racon_tpu/cache/ via obs/metrics.py
     # record_cache): which tier (job CAS / window memo) and which
     # outcome (hit/miss/store/evict/verify_fail) — per-window probes
@@ -155,6 +157,13 @@ def validate(tr: Dict[str, object]) -> List[str]:
         if "run_fp" in s and not isinstance(s["run_fp"], str):
             errs.append(f"span {sid}: run_fp must be a string, got "
                         f"{s['run_fp']!r}")
+        if "trace_id" in s and not isinstance(s["trace_id"], str):
+            errs.append(f"span {sid}: trace_id must be a string, got "
+                        f"{s['trace_id']!r}")
+        if "parent_id" in s and (not isinstance(s["parent_id"], int) or
+                                 isinstance(s["parent_id"], bool)):
+            errs.append(f"span {sid}: parent_id must be an integer, "
+                        f"got {s['parent_id']!r}")
         parent = s.get("parent")
         if parent is not None:
             p = spans.get(parent)
@@ -260,7 +269,8 @@ def render(tr: Dict[str, object], out=None,
     _render_pipeline(m, out)
     _render_resilience(m, by_kind, out)
     _render_dist(m, by_kind, out)
-    _render_server(m, by_kind, out)
+    _render_server(m, by_kind, out, trace_end_unix=_trace_end_unix(tr))
+    _render_hist(m, out)
     _render_cache(m, by_kind, out)
     if fleet_dir:
         _render_fleet(fleet_dir, out)
@@ -445,7 +455,22 @@ def _render_dist(m, by_kind, out) -> None:
         print(f"  events by worker: {workers}", file=out)
 
 
-def _render_server(m, by_kind, out) -> None:
+def _trace_end_unix(tr) -> Optional[float]:
+    """Wall-clock instant of the last span end: the begin header's
+    unix_time plus the latest relative span end. None when the trace
+    has no absolute anchor (old traces, empty traces)."""
+    begin = tr.get("begin") or {}
+    t0 = begin.get("unix_time")
+    spans = tr.get("spans") or {}
+    if not isinstance(t0, (int, float)) or not spans:
+        return None
+    return float(t0) + max(
+        (s["t0"] + s["dur_s"] for s in spans.values()
+         if isinstance(s.get("t0"), (int, float)) and
+         isinstance(s.get("dur_s"), (int, float))), default=0.0)
+
+
+def _render_server(m, by_kind, out, trace_end_unix=None) -> None:
     """The "server:" section: daemon job lifecycle totals, the
     cross-request batcher's packing efficiency, and per-tenant event
     counts, from the ``serve_*`` metrics and ``serve`` points the
@@ -471,7 +496,24 @@ def _render_server(m, by_kind, out) -> None:
               f"s", file=out)
     rate = m.get("serve_jobs_per_min")
     if rate is not None:
-        print(f"  throughput: {float(rate):.4f} job(s)/min", file=out)
+        # Rate/occupancy gauges are only as fresh as their last stamp:
+        # a snapshot much older than the trace's end (> the fleet
+        # staleness budget, 5x the flush cadence) is flagged so nobody
+        # reads a dead daemon's last throughput as current.
+        stale = ""
+        stamp = m.get("serve_rate_wall_s")
+        if isinstance(stamp, (int, float)) and trace_end_unix:
+            import os
+            sys.path.insert(0, os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+            from racon_tpu.obs.export import SUPERVISOR_STALE_FACTOR
+            from racon_tpu.obs.fleet import DEFAULT_FLUSH_S
+            age = float(trace_end_unix) - float(stamp)
+            if age > SUPERVISOR_STALE_FACTOR * DEFAULT_FLUSH_S:
+                stale = (f"  [STALE: gauges last updated {age:.1f}s "
+                         f"before trace end]")
+        print(f"  throughput: {float(rate):.4f} job(s)/min{stale}",
+              file=out)
     if spans:
         # Batch points carry comma-joined tenant lists; split them so a
         # tenant's count includes every dispatch it rode in.
@@ -482,6 +524,91 @@ def _render_server(m, by_kind, out) -> None:
         tenants = ", ".join(f"{t}: {n}" for t, n in
                             sorted(by_tenant.items()))
         print(f"  events by tenant: {tenants}", file=out)
+
+
+def _render_hist(m, out) -> None:
+    """The "latency:" section: p50/p95/p99 for every histogram family
+    in the metrics snapshot, interpolated from the fixed log-spaced
+    buckets declared in obs/metrics.HIST_BUCKETS. Snapshots with no
+    recorded histograms print nothing."""
+    m = m or {}
+    hists = {k: v for k, v in sorted(m.items())
+             if isinstance(v, dict) and "buckets" in v
+             and int(v.get("count", 0) or 0)}
+    if not hists:
+        return
+    import os
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from racon_tpu.obs.metrics import HIST_BUCKETS, hist_quantile
+    print(f"\nlatency:  {'count':>6}  {'p50':>9}  {'p95':>9}  "
+          f"{'p99':>9}  family", file=out)
+    for name, h in hists.items():
+        bounds = HIST_BUCKETS.get(name)
+        if bounds is None:
+            continue
+        p50, p95, p99 = (hist_quantile(h, q, bounds)
+                         for q in (0.50, 0.95, 0.99))
+        print(f"{'':>8}  {int(h['count']):>6}  {p50:>9.4f}  "
+              f"{p95:>9.4f}  {p99:>9.4f}  {name}", file=out)
+
+
+def _render_job(root: str, trace_id: str, out=None) -> int:
+    """The ``--job TRACE_ID`` mode: stitch one job's causal timeline
+    out of every per-process trace under ``<root>/obs`` (the fleet
+    merge step, obs/fleet.assemble_job_timeline), then render any
+    flight-recorder dumps beside it and the aggregated latency
+    histograms. Returns an exit code; refusals (no such trace, mixed
+    runs) surface as errors, never empty reports."""
+    import os
+    if out is None:
+        out = sys.stdout
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from racon_tpu.obs import flightrec
+    from racon_tpu.obs.fleet import (FleetObsError, aggregate,
+                                     assemble_job_timeline, obs_dir_for)
+    try:
+        tl = assemble_job_timeline(root, trace_id)
+    except (FleetObsError, OSError) as exc:
+        print(f"[obs_report] error: {exc}", file=sys.stderr)
+        return 1
+    print(f"job {tl['trace_id']}: {tl['n_spans']} span(s) across "
+          f"{tl['n_processes']} process(es)", file=out)
+    for src in sorted(tl["sources"]):
+        print(f"  {src}: {tl['sources'][src]} span(s)", file=out)
+    t_base = tl["spans"][0]["t_abs"] if tl["spans"] else 0.0
+    print(f"\n{'t_rel_s':>9}  {'dur_s':>8}  {'source':<22}  span",
+          file=out)
+    for s in tl["spans"]:
+        name = f"{s['kind']}/{s['name']}"
+        extra = ""
+        if s.get("kind") == "serve":
+            extra = f"  job={s.get('job')} tenant={s.get('tenant')}"
+        elif "worker_id" in s:
+            extra = f"  worker={s['worker_id']}"
+        print(f"{s['t_abs'] - t_base:>9.3f}  {s['dur_s']:>8.3f}  "
+              f"{s['src']:<22}  {name}{extra}", file=out)
+    flights = flightrec.list_flights(obs_dir_for(root))
+    for path in flights:
+        try:
+            fl = flightrec.load_flight(path)
+        except ValueError as exc:
+            print(f"\nflight {os.path.basename(path)}: unreadable "
+                  f"({exc})", file=out)
+            continue
+        h = fl["header"]
+        tear = "" if fl["clean"] else "  [TORN: clean prefix shown]"
+        print(f"\nflight {os.path.basename(path)}: pid={h['pid']}  "
+              f"reason={h['reason']}  {len(fl['events'])} event(s)"
+              f"{tear}", file=out)
+        for e in fl["events"][-8:]:
+            print(f"  {json.dumps(e, sort_keys=True)}", file=out)
+    try:
+        _render_hist(aggregate(root).get("fleet", {}), out)
+    except (FleetObsError, OSError):
+        pass  # no metric shards next to the traces — timeline stands
+    return 0
 
 
 def _render_cache(m, by_kind, out) -> None:
@@ -646,11 +773,26 @@ def main(argv: Optional[List[str]] = None) -> int:
                   "directory", file=sys.stderr)
             return 2
         del argv[i:i + 2]
+    job_trace = None
+    if "--job" in argv:
+        i = argv.index("--job")
+        try:
+            job_trace = argv[i + 1]
+        except IndexError:
+            print("[obs_report] error: --job needs a trace id",
+                  file=sys.stderr)
+            return 2
+        del argv[i:i + 2]
     paths = [a for a in argv if not a.startswith("--")]
     if len(paths) != 1 or len(argv) != len(paths):
         print("usage: obs_report.py TRACE.jsonl [--validate] "
-              "[--fleet LEDGER_DIR]", file=sys.stderr)
+              "[--fleet LEDGER_DIR] | obs_report.py ROOT_DIR "
+              "--job TRACE_ID", file=sys.stderr)
         return 2
+    if job_trace is not None:
+        # --job mode: the positional is a run/ledger root holding an
+        # obs/ directory of per-process traces, not a single trace.
+        return _render_job(paths[0], job_trace)
     try:
         tr = load_trace(paths[0])
     except (OSError, TraceError) as exc:

@@ -1,0 +1,10 @@
+"""Seeded histogram violation (lint fixture — never imported).
+
+HIS001: a record_hist family with no HIST_BUCKETS bounds.
+"""
+
+from racon_tpu.obs.metrics import record_hist
+
+
+def observe():
+    record_hist("zz_ghost_latency_s", 0.1)                # HIS001

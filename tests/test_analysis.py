@@ -209,6 +209,49 @@ def test_determinism_fires():
     assert len(found) == 2  # time.time AND random.random
 
 
+# --------------------------------------------------------------- histogram
+
+
+def test_histogram_clean(repo_findings):
+    _clean(repo_findings, "histogram")
+
+
+def test_histogram_fires():
+    found = list(_rule("histogram").check(
+        _fixture_ctx("histogram_violation.py")))
+    assert _ids(found) == {"HIS001"}
+
+    # Registry directions: one injected family that nothing records,
+    # with a 'hist' spec row but an empty corpus — the dead-producer
+    # and missing-exporter directions both trip.
+    ctx = Context(REPO, files=[], full=True,
+                  hist_buckets={"zz_ghost_latency_s": (0.1, 1.0)},
+                  metric_specs=(("zz_ghost_latency_s", "hist",
+                                 "zz_ghost_doc"),))
+    found = list(_rule("histogram").check(ctx))
+    assert _ids(found) == {"HIS001"}
+    msgs = "\n".join(f.message for f in found)
+    assert "recorded nowhere" in msgs
+    assert "no OpenMetrics histogram rendering" in msgs
+
+    # A 'hist' spec row with no bounds behind it.
+    ctx = Context(REPO, files=[], full=True, hist_buckets={},
+                  metric_specs=(("zz_ghost_latency_s", "hist",
+                                 "zz_ghost_doc"),))
+    found = list(_rule("histogram").check(ctx))
+    assert any("no bounds" in f.message for f in found)
+
+
+def test_histogram_pragma_suppresses(tmp_path):
+    p = tmp_path / "pragma_case.py"
+    p.write_text("from racon_tpu.obs.metrics import record_hist\n"
+                 "def observe():\n"
+                 "    # lint: hist-ok (scratch family)\n"
+                 "    record_hist('zz_scratch_s', 0.1)\n")
+    ctx = Context(REPO, files=[str(p)], full=False)
+    assert list(_rule("histogram").check(ctx)) == []
+
+
 # ------------------------------------------------------- cache surface
 
 

@@ -1,0 +1,444 @@
+"""End-to-end job telemetry tests: cross-process trace propagation
+(obs/trace.py), latency histograms (obs/metrics.py + obs/export.py),
+and the crash flight recorder (obs/flightrec.py) — the contracts in
+docs/OBSERVABILITY.md "Cross-process trace propagation" and
+"Post-mortem debugging"."""
+
+import io
+import json
+import os
+import sys
+
+import pytest
+
+from racon_tpu.obs import export as obs_export
+from racon_tpu.obs import fleet as obs_fleet
+from racon_tpu.obs import flightrec
+from racon_tpu.obs import metrics as obs_metrics
+from racon_tpu.obs import trace as obs_trace
+from racon_tpu.resilience import faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def telemetry_sandbox(monkeypatch):
+    """Keep the process-global tracer, registry, injector, and flight
+    ring out of other tests (and their env out of these)."""
+    for env in (faults.ENV_FAULTS, obs_fleet.ENV_OBS_DIR,
+                obs_trace.ENV_TRACE, obs_trace.ENV_TRACE_CTX,
+                flightrec.ENV_FLIGHT_EVENTS):
+        monkeypatch.delenv(env, raising=False)
+    def _drop_tracer():
+        if isinstance(obs_trace._tracer, obs_trace.Tracer):
+            obs_trace._tracer.finish()
+        obs_trace._tracer = None
+
+    faults.configure(None)
+    obs_metrics.reset()
+    flightrec.reset()
+    _drop_tracer()
+    obs_fleet._WRITER = None
+    yield
+    faults.configure(None)
+    obs_metrics.reset()
+    flightrec.reset()
+    _drop_tracer()
+    obs_fleet._WRITER = None
+
+
+class _Died(BaseException):
+    """Stand-in for os._exit in in-process crash drills."""
+
+
+@pytest.fixture
+def soft_crash(monkeypatch):
+    monkeypatch.setattr(faults, "hard_exit",
+                        lambda code: (_ for _ in ()).throw(_Died(code)))
+    return _Died
+
+
+# ------------------------------------------------------- trace context
+
+
+def test_trace_context_roundtrip():
+    ctx = obs_trace.mint_trace_context("a" * 64, parent_id=7)
+    assert ctx.trace_id == "a" * obs_trace.TRACE_ID_LEN
+    assert ctx.parent_id == 7
+    assert obs_trace.parse_trace_ctx(ctx.encode()) == ctx
+    # The submit point is the root: parent defaults to 0.
+    assert obs_trace.mint_trace_context("beef").parent_id == 0
+
+
+@pytest.mark.parametrize("bad", [
+    None, "", "   ", "nocolonhere", ":7", "abc:", "abc:xyz",
+    "abc:1.5", 12, b"abc:3"])
+def test_parse_trace_ctx_malformed_is_absent(bad):
+    assert obs_trace.parse_trace_ctx(bad) is None
+
+
+def test_adopt_trace_context_tags_spans(tmp_path, monkeypatch):
+    monkeypatch.setenv(obs_trace.ENV_TRACE_CTX, "deadbeefcafef00d:42")
+    tr = obs_trace.Tracer(str(tmp_path / "t.jsonl"))
+    ctx = obs_trace.adopt_trace_context(tracer=tr)
+    assert ctx == obs_trace.TraceContext("deadbeefcafef00d", 42)
+    with tr.span("phase", "p"):
+        pass
+    tr.finish()
+    spans = [json.loads(ln) for ln in open(tmp_path / "t.jsonl")
+             if json.loads(ln).get("ev") == "span"]
+    assert spans[0]["trace_id"] == "deadbeefcafef00d"
+    assert spans[0]["parent_id"] == 42
+
+
+def test_adopt_malformed_env_degrades_to_fresh_root(tmp_path,
+                                                    monkeypatch):
+    """A garbled handoff must NOT crash the worker — it keeps a fresh
+    root trace (adoption-edge satellite)."""
+    tr = obs_trace.Tracer(str(tmp_path / "t.jsonl"))
+    for bad in ("%%%", "abc:notanint", ":", ""):
+        monkeypatch.setenv(obs_trace.ENV_TRACE_CTX, bad)
+        assert obs_trace.adopt_trace_context(tracer=tr) is None
+    monkeypatch.delenv(obs_trace.ENV_TRACE_CTX)
+    assert obs_trace.adopt_trace_context(tracer=tr) is None
+    with tr.span("phase", "p"):
+        pass
+    tr.finish()
+    spans = [json.loads(ln) for ln in open(tmp_path / "t.jsonl")
+             if json.loads(ln).get("ev") == "span"]
+    assert "trace_id" not in spans[0]
+
+
+def test_env_trace_ctx_validates(monkeypatch):
+    monkeypatch.setenv(obs_trace.ENV_TRACE_CTX, "abcd:3")
+    assert obs_trace.env_trace_ctx() == "abcd:3"
+    monkeypatch.setenv(obs_trace.ENV_TRACE_CTX, "garbage")
+    assert obs_trace.env_trace_ctx() == ""
+
+
+def test_serve_span_carries_context(tmp_path, monkeypatch):
+    monkeypatch.setenv(obs_trace.ENV_TRACE, str(tmp_path / "t.jsonl"))
+    reg = obs_metrics.MetricsRegistry()
+    sid = obs_metrics.record_serve_job("submitted", "j1", "t1",
+                                       trace_id="cafe1234cafe1234",
+                                       reg=reg)
+    assert sid > 0
+    obs_trace.get_tracer().finish()
+    spans = [json.loads(ln) for ln in open(tmp_path / "t.jsonl")
+             if json.loads(ln).get("ev") == "span"]
+    assert spans[0]["id"] == sid
+    assert spans[0]["trace_id"] == "cafe1234cafe1234"
+    assert spans[0]["parent_id"] == 0
+
+
+def test_report_validates_trace_attr_types(tmp_path):
+    sys.path.insert(0, REPO)
+    from scripts import obs_report
+    path = tmp_path / "t.jsonl"
+    lines = [
+        {"ev": "begin", "schema": 1, "unix_time": 0.0},
+        {"ev": "span", "id": 1, "parent": None, "kind": "serve",
+         "name": "submitted", "t0": 0.0, "dur_s": 0.0, "job": "j",
+         "tenant": "t", "trace_id": 99, "parent_id": "zero"},
+    ]
+    with open(path, "w") as fh:
+        for ln in lines:
+            fh.write(json.dumps(ln) + "\n")
+    errs = obs_report.validate(obs_report.load_trace(str(path)))
+    assert any("trace_id must be a string" in e for e in errs)
+    assert any("parent_id must be an integer" in e for e in errs)
+
+
+# ---------------------------------------------------------- histograms
+
+
+def test_record_hist_bins_sum_count():
+    reg = obs_metrics.MetricsRegistry()
+    bounds = obs_metrics.HIST_BUCKETS["serve_queue_wait_s"]
+    obs_metrics.record_hist("serve_queue_wait_s", 0.02, reg=reg)
+    obs_metrics.record_hist("serve_queue_wait_s", 0.02, reg=reg)
+    obs_metrics.record_hist("serve_queue_wait_s", 999.0, reg=reg)
+    h = reg.snapshot()["serve_queue_wait_s"]
+    assert len(h["buckets"]) == len(bounds) + 1   # + overflow
+    assert sum(h["buckets"]) == h["count"] == 3
+    assert h["buckets"][-1] == 1                  # the overflow obs
+    assert h["sum"] == pytest.approx(999.04)
+    # An unknown family is a programming error, not a silent drop.
+    with pytest.raises(KeyError):
+        obs_metrics.record_hist("zz_not_a_family", 1.0, reg=reg)
+
+
+def test_hist_quantiles_and_percentiles():
+    reg = obs_metrics.MetricsRegistry()
+    for v in (0.06, 0.06, 0.3, 0.3, 8.0):
+        obs_metrics.record_hist("serve_job_latency_s", v, reg=reg)
+    pcts = obs_metrics.hist_percentiles("serve_job_latency_s", reg=reg)
+    assert set(pcts) == {"serve_job_latency_s_p50",
+                         "serve_job_latency_s_p95",
+                         "serve_job_latency_s_p99"}
+    assert 0.05 <= pcts["serve_job_latency_s_p50"] <= 0.5
+    assert 5.0 <= pcts["serve_job_latency_s_p95"] <= 10.0
+    # Empty family: no keys, and the quantile helper answers 0.
+    assert obs_metrics.hist_percentiles("serve_queue_wait_s",
+                                        reg=reg) == {}
+    assert obs_metrics.hist_quantile({"buckets": [], "count": 0},
+                                     0.5, (1.0,)) == 0.0
+
+
+def test_hist_merge_folds_per_bucket():
+    ra, rb = obs_metrics.MetricsRegistry(), obs_metrics.MetricsRegistry()
+    obs_metrics.record_hist("dispatch_round_s", 0.02, reg=ra)
+    obs_metrics.record_hist("dispatch_round_s", 0.3, reg=rb)
+    obs_metrics.record_hist("dispatch_round_s", 0.3, reg=rb)
+    ha = ra.snapshot()["dispatch_round_s"]
+    hb = rb.snapshot()["dispatch_round_s"]
+    assert obs_metrics.merge_kind("dispatch_round_s") == \
+        obs_metrics.MERGE_HIST
+    merged = obs_metrics.merge_values("dispatch_round_s",
+                                      [ha, None, hb])
+    assert merged["count"] == 3
+    assert merged["sum"] == pytest.approx(0.62)
+    assert sum(merged["buckets"]) == 3
+    assert [a + b for a, b in zip(ha["buckets"], hb["buckets"])] == \
+        merged["buckets"]
+
+
+def test_openmetrics_histogram_render():
+    reg = obs_metrics.MetricsRegistry()
+    for v in (0.02, 0.3, 0.3, 999.0):
+        obs_metrics.record_hist("serve_queue_wait_s", v, reg=reg)
+    reg.inc("dist_claims")
+    text = obs_export.render_registry(reg.snapshot())
+    assert obs_export.validate_openmetrics(text) == []
+    assert text == obs_export.render_registry(reg.snapshot())
+    assert "# TYPE racon_tpu_serve_queue_wait_s histogram" in text
+    # Cumulative le series, closed by +Inf == _count.
+    assert 'racon_tpu_serve_queue_wait_s_bucket{le="0.025"} 1' in text
+    assert 'racon_tpu_serve_queue_wait_s_bucket{le="0.5"} 3' in text
+    assert 'racon_tpu_serve_queue_wait_s_bucket{le="+Inf"} 4' in text
+    assert "racon_tpu_serve_queue_wait_s_count 4" in text
+    assert "racon_tpu_serve_queue_wait_s_sum 999.62" in text
+
+
+def test_fleet_render_folds_histograms(tmp_path):
+    for wid, values in (("A", (0.02, 0.3)), ("B", (0.3,))):
+        reg = obs_metrics.MetricsRegistry()
+        for v in values:
+            obs_metrics.record_hist("serve_queue_wait_s", v, reg=reg)
+        w = obs_fleet.WorkerMetricsWriter(str(tmp_path), wid, "fp1",
+                                          reg=reg, interval_s=0.0)
+        w.flush(final=True)
+    model = obs_fleet.aggregate(str(tmp_path))
+    assert model["fleet"]["serve_queue_wait_s"]["count"] == 3
+    text = obs_export.render_fleet(model)
+    assert obs_export.validate_openmetrics(text) == []
+    assert 'racon_tpu_serve_queue_wait_s_bucket{le="+Inf"} 3' in text
+
+
+# ------------------------------------------------------ flight recorder
+
+
+def test_flight_ring_is_bounded():
+    rec = flightrec.FlightRecorder(4)
+    for i in range(10):
+        rec.note({"i": i})
+    assert [e["i"] for e in rec.events()] == [6, 7, 8, 9]
+    off = flightrec.FlightRecorder(0)
+    off.note({"i": 1})
+    assert off.events() == []
+
+
+def test_flight_capacity_from_env(monkeypatch):
+    monkeypatch.setenv(flightrec.ENV_FLIGHT_EVENTS, "3")
+    flightrec.reset()
+    assert flightrec.recorder().capacity == 3
+    monkeypatch.setenv(flightrec.ENV_FLIGHT_EVENTS, "nope")
+    flightrec.reset()
+    assert flightrec.recorder().capacity == flightrec.DEFAULT_EVENTS
+
+
+def test_flight_dump_load_roundtrip(tmp_path):
+    flightrec.note_span({"ev": "span", "id": 1, "kind": "phase",
+                         "name": "p", "t0": 0.0, "dur_s": 0.1})
+    flightrec.note_metric("dist_claims", 2)
+    flightrec.note_breach("h2d", 5.0, 7.5, terminal=True)
+    path = flightrec.dump(str(tmp_path), reason="unit-test")
+    assert os.path.basename(path) == f"flight_{os.getpid()}.json"
+    rec = flightrec.load_flight(path)
+    assert rec["clean"]
+    assert rec["header"]["reason"] == "unit-test"
+    assert rec["header"]["events"] == 3
+    assert [e["ev"] for e in rec["events"]] == ["span", "metric",
+                                                "breach"]
+    assert rec["metrics"] is not None
+    # Dumps are discoverable and the write accounted for itself.
+    assert flightrec.list_flights(str(tmp_path)) == [path]
+    snap = obs_metrics.registry().snapshot()
+    assert snap["flight_dumps_total"] == 1
+    assert snap["flight_dump_write_s"] > 0
+    # No resolvable directory: best-effort no-op, never a raise.
+    assert flightrec.dump(None, reason="x") == ""
+
+
+def test_load_flight_rejects_non_dumps(tmp_path):
+    p = tmp_path / "flight_1.json"
+    p.write_text('{"ev": "span"}\n')
+    with pytest.raises(ValueError, match="not a flight dump"):
+        flightrec.load_flight(str(p))
+
+
+def test_torn_flight_dump_loads_as_prefix(tmp_path, soft_crash):
+    """The obs/flight drill: a dump torn mid-write (SIGKILL racing the
+    flush) must still load as a valid prefix — header plus every
+    complete ring line before the tear."""
+    for i in range(6):
+        flightrec.note_metric("dist_claims", i)
+    # Pad the trailing metrics-snapshot line past the tear length so
+    # the truncation lands mid-record, not on a line boundary.
+    obs_metrics.registry().inc("dist_claims", 123456789)
+    obs_metrics.registry().inc("poa_windows_total", 987654321)
+    faults.configure("obs/flight:0!torn")
+    with pytest.raises(soft_crash):
+        flightrec.dump(str(tmp_path), reason="kill")
+    faults.configure(None)
+    rec = flightrec.load_flight(flightrec.flight_path(str(tmp_path)))
+    assert not rec["clean"]                      # the tear is visible
+    assert rec["header"]["reason"] == "kill"
+    # 6 direct notes + 2 fed through the global-registry incs above.
+    assert rec["header"]["events"] == 8
+    assert len(rec["events"]) <= 8               # prefix, never junk
+    assert all(e["ev"] == "metric" for e in rec["events"])
+    # A later clean dump overwrites the torn file atomically.
+    path = flightrec.dump(str(tmp_path), reason="retry")
+    assert flightrec.load_flight(path)["clean"]
+
+
+def test_flush_final_dumps_flight_beside_shards(tmp_path):
+    obs_fleet.install_writer(str(tmp_path), "W", "fp1",
+                             reg=obs_metrics.MetricsRegistry(),
+                             interval_s=0.0)
+    flightrec.note_metric("dist_claims", 1)
+    obs_fleet.flush_final(reason="watchdog-terminal")
+    flights = flightrec.list_flights(str(tmp_path))
+    assert len(flights) == 1
+    rec = flightrec.load_flight(flights[0])
+    assert rec["header"]["reason"] == "watchdog-terminal"
+    assert obs_fleet.load_worker_shards(str(tmp_path))[0]["records"][-1][
+        "final"]
+
+
+# ------------------------------------------------------ job timelines
+
+
+def _trace_file(path, begin_unix, spans):
+    lines = [{"ev": "begin", "schema": 1, "unix_time": begin_unix}]
+    lines.extend(spans)
+    with open(path, "w") as fh:
+        for ln in lines:
+            fh.write(json.dumps(ln) + "\n")
+
+
+def _span(sid, kind, name, t0, trace_id=None, **attrs):
+    s = {"ev": "span", "id": sid, "parent": None, "kind": kind,
+         "name": name, "t0": t0, "dur_s": 0.1, **attrs}
+    if trace_id is not None:
+        s["trace_id"] = trace_id
+    return s
+
+
+TID = "deadbeefcafef00d"
+
+
+def _three_process_obs(root):
+    obs = os.path.join(root, obs_fleet.OBS_SUBDIR)
+    os.makedirs(obs, exist_ok=True)
+    _trace_file(os.path.join(obs, "daemon.jsonl"), 100.0, [
+        _span(1, "serve", "submitted", 0.5, TID, job="j1", tenant="t",
+              parent_id=0, run_fp="fp1"),
+        _span(2, "phase", "unrelated", 0.6, run_fp="fp1"),
+    ])
+    # A batch span serving two jobs: comma-joined trace ids match both.
+    _trace_file(os.path.join(obs, "worker_A.trace.jsonl"), 101.0, [
+        _span(1, "dispatch", "batch", 0.2,
+              f"{TID},1111222233334444", run_fp="fp1",
+              worker_id="A"),
+    ])
+    # A hard-killed worker never promoted its .part sidecar — its
+    # spans are exactly the interesting ones.
+    _trace_file(os.path.join(obs, "worker_B.trace.jsonl.part"), 102.0, [
+        _span(1, "phase", "polish", 0.1, TID, run_fp="fp1",
+              worker_id="B"),
+    ])
+    return obs
+
+
+def test_assemble_job_timeline_stitches_processes(tmp_path):
+    _three_process_obs(str(tmp_path))
+    tl = obs_fleet.assemble_job_timeline(str(tmp_path), TID)
+    assert tl["trace_id"] == TID
+    assert tl["n_processes"] == 3
+    assert tl["n_spans"] == 3
+    assert tl["sources"] == {"daemon.jsonl": 1,
+                             "worker_A.trace.jsonl": 1,
+                             "worker_B.trace.jsonl.part": 1}
+    # Sorted on the common wall clock, not per-file order.
+    assert [s["t_abs"] for s in tl["spans"]] == [100.5, 101.2, 102.1]
+    assert [s["src"] for s in tl["spans"]] == [
+        "daemon.jsonl", "worker_A.trace.jsonl",
+        "worker_B.trace.jsonl.part"]
+
+
+def test_assemble_refuses_unknown_and_mixed(tmp_path):
+    obs = _three_process_obs(str(tmp_path))
+    with pytest.raises(obs_fleet.FleetObsError, match="no span"):
+        obs_fleet.assemble_job_timeline(str(tmp_path), "f" * 16)
+    # A stale trace from a previous run sharing the directory: refuse
+    # rather than fabricate a timeline that never happened.
+    _trace_file(os.path.join(obs, "stale.jsonl"), 90.0, [
+        _span(1, "phase", "old", 0.1, TID, run_fp="fp0"),
+    ])
+    with pytest.raises(obs_fleet.FleetObsError, match="mixed runs"):
+        obs_fleet.assemble_job_timeline(str(tmp_path), TID)
+
+
+def test_obs_report_job_mode_renders_timeline(tmp_path):
+    sys.path.insert(0, REPO)
+    from scripts import obs_report
+    _three_process_obs(str(tmp_path))
+    # A flight dump beside the traces renders in the same report.
+    flightrec.note_metric("dist_claims", 1)
+    flightrec.dump(os.path.join(str(tmp_path), obs_fleet.OBS_SUBDIR),
+                   reason="drill")
+    out = io.StringIO()
+    assert obs_report._render_job(str(tmp_path), TID, out=out) == 0
+    text = out.getvalue()
+    assert f"job {TID}: 3 span(s) across 3 process(es)" in text
+    assert "worker_B.trace.jsonl.part" in text
+    assert "serve/submitted" in text
+    assert "reason=drill" in text
+    # Unknown trace ids are loud errors, never empty reports.
+    assert obs_report._render_job(str(tmp_path), "f" * 16,
+                                  out=io.StringIO()) == 1
+
+
+def test_obs_report_flags_stale_throughput(tmp_path):
+    sys.path.insert(0, REPO)
+    from scripts import obs_report
+    m = {"serve_jobs_submitted": 1, "serve_jobs_per_min": 2.0,
+         "serve_rate_wall_s": 100.0}
+    budget = (obs_export.SUPERVISOR_STALE_FACTOR *
+              obs_fleet.DEFAULT_FLUSH_S)
+    out = io.StringIO()
+    obs_report._render_server(m, {}, out,
+                              trace_end_unix=100.0 + budget + 1.0)
+    assert "[STALE: gauges last updated" in out.getvalue()
+    out = io.StringIO()
+    obs_report._render_server(m, {}, out,
+                              trace_end_unix=100.0 + budget - 1.0)
+    assert "STALE" not in out.getvalue()
+    # No stamp (pre-telemetry snapshots): no flag, no crash.
+    out = io.StringIO()
+    obs_report._render_server({"serve_jobs_submitted": 1,
+                               "serve_jobs_per_min": 2.0}, {}, out,
+                              trace_end_unix=1e9)
+    assert "STALE" not in out.getvalue()
