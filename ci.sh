@@ -89,4 +89,7 @@ python scripts/cache_smoke.py
 echo "[ci] job trace smoke (daemon + 2-worker fleet, ctx handoff, mid-shard kill, 3-process timeline + flight dump)"
 python scripts/job_trace_smoke.py
 
+echo "[ci] fleet serve smoke (gateway routing, worker kill, warm pool, standby adoption, byte-diff)"
+python scripts/fleet_serve_smoke.py
+
 echo "[ci] OK"

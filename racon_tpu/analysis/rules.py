@@ -483,6 +483,7 @@ def check_span_schema(ctx: Context) -> Iterator[Finding]:
 
 def check_atomic_write(ctx: Context) -> Iterator[Finding]:
     for path in ctx.scoped("racon_tpu/cache/", "racon_tpu/distributed/",
+                           "racon_tpu/gateway/",
                            "racon_tpu/resilience/", "racon_tpu/obs/"):
         rel = ctx.rel(path)
         if rel == "racon_tpu/utils/atomicio.py":

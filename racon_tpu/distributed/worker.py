@@ -144,6 +144,27 @@ def _open_store(ledger: WorkLedger, shard) -> ckpt.CheckpointStore:
     return ckpt.CheckpointStore.create(d, fp)
 
 
+def _shard_cache():
+    """The fleet-shared shard CAS, or None when unarmed. Gateway runs
+    arm it by pointing ``RACON_TPU_CACHE_DIR`` at one directory under
+    the gateway root (docs/GATEWAY.md), so every worker on every run
+    shares one Tier-1 store keyed by shard fingerprint — a resubmitted
+    fleet job replays its shards without polishing a window. Plain
+    ledger runs leave the env unset and skip all of this; the global
+    ``RACON_TPU_CACHE=0`` kill switch is honoured here too."""
+    from racon_tpu.cache import ENV_CACHE_DIR, cache_enabled
+    cache_dir = envspec.read(ENV_CACHE_DIR).strip()
+    if not cache_dir or not cache_enabled():
+        return None
+    from racon_tpu.cache import ResultCache
+    try:
+        return ResultCache(cache_dir)
+    except Exception as exc:
+        print(f"[racon_tpu::dist] shard cache disabled ({exc})",
+              file=sys.stderr)
+        return None
+
+
 def _polish_shard(ledger: WorkLedger, claim: Claim,
                   make_polisher: Callable, drop_unpolished: bool, log,
                   t_shard: float) -> int:
@@ -161,8 +182,26 @@ def _polish_shard(ledger: WorkLedger, claim: Claim,
     """
     info = claim.info
     store = _open_store(ledger, info)
+    cache = _shard_cache()
     try:
         start = info.start
+        if cache is not None and not store.committed:
+            # Fleet-shared Tier-1 probe: a verified hit replays the
+            # whole shard's committed records into this store — the
+            # polish loop below then sees a fully-resumed shard and
+            # computes nothing. Probes only on a fresh store: a
+            # partially-committed (stolen) shard already resumes from
+            # its own prefix.
+            hit = cache.load(ledger.shard_fp(info))
+            if hit is not None:
+                from racon_tpu.cache import replay_records
+                replay_records(hit, store=store)
+                record_dist("contigs_replayed", claim.shard,
+                            claim.worker, value=len(store.committed))
+                print(f"[racon_tpu::dist] worker {claim.worker}: "
+                      f"shard {info.name} replayed from the shared "
+                      f"cache ({len(store.committed)} contig(s))",
+                      file=log)
         if store.committed:
             # A stolen (or re-claimed) shard: everything the victim
             # committed re-emits from its store, zero recompute.
@@ -198,7 +237,7 @@ def _polish_shard(ledger: WorkLedger, claim: Claim,
             if tid + 1 < claim.info.end:
                 _maybe_split(ledger, claim, tid + 1, t_shard, log)
 
-        return polish_job(
+        n = polish_job(
             make_polisher, drop_unpolished=drop_unpolished,
             store=store, tid_range=(start, info.end), fill_drops=True,
             hooks=JobHooks(
@@ -207,6 +246,16 @@ def _polish_shard(ledger: WorkLedger, claim: Claim,
                 before_commit=_before_commit,
                 after_commit=_after_commit,
                 before_fill=lambda tid: ledger.renew(claim)))
+        if cache is not None:
+            # Publish the finished shard for the next run of this
+            # fingerprint; cache trouble never fails a polished shard.
+            from racon_tpu.cache import records_from_store
+            try:
+                cache.store(ledger.shard_fp(info),
+                            records_from_store(store))
+            except OSError:
+                pass
+        return n
     finally:
         store.close()
 

@@ -1,0 +1,32 @@
+"""Fleet-serve gateway: daemon jobs executed on the elastic ledger fleet.
+
+The daemon (racon_tpu/server/) and the elastic fleet
+(racon_tpu/distributed/) are the two halves of the polishing service;
+this package is the seam that joins them (docs/GATEWAY.md):
+
+- ``dispatch.py`` — the job→ledger adapter: routes each accepted
+  :class:`~racon_tpu.server.engine.JobSpec` to the in-process batcher
+  (small jobs) or to an autoscaled ledger fleet (large jobs / queue
+  pressure), materializing one ``WorkLedger`` per fleet job keyed by
+  the job fingerprint so a crashed or resubmitted run resumes
+  byte-identically.
+- ``ha.py`` — gateway fail-over: a nonce-fenced gateway lease (the
+  ``distributed/ledger.py`` discipline applied to the daemon itself)
+  lets a standby replica adopt the journal's in-flight jobs after a
+  primary crash.
+- ``policy.py`` — cross-host autoscaling from service signals: the
+  fleet target is driven by queue depth and queue-wait latency, not
+  only pending-shard counts.
+"""
+
+from racon_tpu.gateway.dispatch import (FleetDispatchError, RouteDecision,
+                                        decide_route, fleet_paths,
+                                        run_fleet_job)
+from racon_tpu.gateway.ha import GatewayLease, GatewayLeaseLost
+from racon_tpu.gateway.policy import service_target
+
+__all__ = [
+    "FleetDispatchError", "GatewayLease", "GatewayLeaseLost",
+    "RouteDecision", "decide_route", "fleet_paths", "run_fleet_job",
+    "service_target",
+]

@@ -1,0 +1,270 @@
+"""The job→ledger adapter: one accepted JobSpec becomes one fleet run.
+
+Routing happens at the seam the daemon already owns
+(``PolishServer._run_job``, after the Tier-1 CAS probe): small jobs
+stay on the resident in-process batcher — the cross-request packing
+path is strictly better for them — and large jobs (or any job arriving
+under queue pressure) are dispatched to an autoscaled ledger fleet.
+The decision is pure policy over two numbers:
+
+- ``n_targets`` — the job's target count (a one-pass index scan of the
+  targets file, the same scan the ledger partitioner runs);
+- ``queue_depth`` — jobs currently waiting on the daemon's admission
+  semaphore.
+
+``RACON_TPU_GATE_FLEET`` arms the fleet path;
+``RACON_TPU_GATE_FLEET_MIN_TARGETS`` is the size threshold and
+``RACON_TPU_GATE_QUEUE_PRESSURE`` the overflow override (a deep queue
+routes even small jobs out — the lone daemon is the bottleneck, not
+the job). ``gate/route`` is the decision's fault site.
+
+A fleet run reuses the distributed plane wholesale: the run directory
+is keyed by the job **fingerprint** (the run identity the ledger and
+the CAS already share), so a resubmitted or crash-adopted job attaches
+to the same ledger and resumes byte-identically — a finished ledger
+short-circuits the fleet entirely and just replays ``out.fasta``.
+Spawned workers inherit three pieces of shared state through the
+environment: the job's trace context (``RACON_TPU_TRACE_CTX``), the
+fleet-shared result CAS (``RACON_TPU_CACHE_DIR`` under the gateway
+root), and the shared jaxcache warm pool (``RACON_TPU_JAX_CACHE``) so
+every worker after the first skips the cold compile (PROFILE.md:
+44.5 s cold vs 12.1 s warm).
+
+The merged FASTA is re-committed contig-by-contig into the job's own
+checkpoint store through the same emit-then-commit order
+``polish_job`` uses — so ``/stream``, the journal, restart recovery,
+and the daemon CAS treat a fleet-executed job exactly like a local
+one.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import time
+from typing import Callable, List, NamedTuple, Optional
+
+from racon_tpu.resilience.faults import maybe_fault
+from racon_tpu.utils import envspec
+
+ENV_GATE_FLEET = "RACON_TPU_GATE_FLEET"
+ENV_MIN_TARGETS = "RACON_TPU_GATE_FLEET_MIN_TARGETS"
+ENV_QUEUE_PRESSURE = "RACON_TPU_GATE_QUEUE_PRESSURE"
+ENV_GATE_WORKERS = "RACON_TPU_GATE_WORKERS"
+
+FLEET_SUBDIR = "fleet"
+POOL_SUBDIR = "jaxcache"
+CAS_SUBDIR = "cas"
+
+
+class FleetDispatchError(RuntimeError):
+    """A fleet run that cannot produce the job's bytes (supervisor
+    failed, merged output missing). The job fails; the ledger keeps
+    whatever was committed for the next attempt to resume."""
+
+
+class RouteDecision(NamedTuple):
+    route: str          # "fleet" | "local"
+    reason: str         # human-readable policy clause that fired
+    n_targets: int
+    queue_depth: int
+
+
+class FleetPaths(NamedTuple):
+    root: str        # <state>/fleet — shared across every fleet job
+    run_dir: str     # <root>/<fp16> — one job fingerprint, one run
+    ledger_dir: str  # <run>/ledger — the WorkLedger workers attach to
+    pool_dir: str    # <root>/jaxcache — shared compile-cache warm pool
+    cas_dir: str     # <root>/cas — fleet-shared result CAS
+
+
+def fleet_enabled() -> bool:
+    return envspec.read(ENV_GATE_FLEET).strip().lower() \
+        not in ("", "0", "false", "off")
+
+
+def count_targets(targets_path: str) -> int:
+    """The job's target count — the routing policy's size signal, via
+    the same streaming index scan the ledger partitioner uses."""
+    from racon_tpu.io.parsers import scan_sequence_index
+    n_records, _offsets = scan_sequence_index(targets_path)
+    return n_records
+
+
+def decide_route(spec, n_targets: int,
+                 queue_depth: int = 0) -> RouteDecision:
+    """Pure routing policy (the test matrix drives this directly).
+    Fleet when armed AND (the job is large enough, or the daemon's
+    queue is deep enough that shipping even a small job out beats
+    waiting). ``gate/route`` fires before the decision is read."""
+    maybe_fault("gate/route")
+    if not fleet_enabled():
+        return RouteDecision("local", "fleet-disabled", n_targets,
+                             queue_depth)
+    min_targets = max(1, int(envspec.read(ENV_MIN_TARGETS)))
+    pressure = max(1, int(envspec.read(ENV_QUEUE_PRESSURE)))
+    if n_targets >= min_targets:
+        return RouteDecision(
+            "fleet", f"n_targets {n_targets} >= {min_targets}",
+            n_targets, queue_depth)
+    if queue_depth >= pressure:
+        return RouteDecision(
+            "fleet", f"queue_depth {queue_depth} >= {pressure}",
+            n_targets, queue_depth)
+    return RouteDecision(
+        "local", f"n_targets {n_targets} < {min_targets}", n_targets,
+        queue_depth)
+
+
+def fleet_paths(state_dir: str, fingerprint: str) -> FleetPaths:
+    """Stable on-disk layout for one fleet job. The run dir is keyed
+    by the job fingerprint — resubmission and standby adoption land on
+    the same ledger; the warm pool and the result CAS are shared
+    across every run under this gateway."""
+    root = os.path.join(state_dir, FLEET_SUBDIR)
+    run_dir = os.path.join(root, fingerprint[:16])
+    return FleetPaths(
+        root=root,
+        run_dir=run_dir,
+        ledger_dir=os.path.join(run_dir, "ledger"),
+        pool_dir=os.path.join(root, POOL_SUBDIR),
+        cas_dir=os.path.join(root, CAS_SUBDIR),
+    )
+
+
+def worker_cli_argv(spec, ledger_dir: str, workers: int) -> List[str]:
+    """The CLI argv an autoscaled fleet worker runs for ``spec`` —
+    identity flags only (JobSpec.identity() is the fingerprint
+    contract), so the workers' run_fingerprint matches the daemon's
+    and the ledger refuses nothing."""
+    argv = list(spec.paths)
+    if spec.include_unpolished:
+        argv.append("--include-unpolished")
+    if spec.fragment_correction:
+        argv.append("--fragment-correction")
+    argv += ["--window-length", str(spec.window_length),
+             "--quality-threshold", str(spec.quality_threshold),
+             "--error-threshold", str(spec.error_threshold),
+             "--match", str(spec.match),
+             "--mismatch", str(spec.mismatch),
+             "--gap", str(spec.gap),
+             "--threads", str(spec.threads),
+             "--backend", spec.backend,
+             "--ledger-dir", ledger_dir,
+             "--workers", str(max(1, int(workers)))]
+    return argv
+
+
+def _split_fasta(blob: bytes) -> List[bytes]:
+    """Split a merged FASTA back into per-contig byte runs. The merge
+    output is the exact concatenation of per-contig emissions, so
+    splitting at ``>`` record starts reconstructs each emission
+    byte-for-byte."""
+    records: List[bytes] = []
+    start = None
+    for line in blob.splitlines(keepends=True):
+        if line.startswith(b">"):
+            if start is not None:
+                records.append(start)
+            start = line
+        elif start is not None:
+            start += line
+    if start is not None:
+        records.append(start)
+    return records
+
+
+def run_fleet_job(job, state_dir: str, store, *,
+                  trace_ctx: str = "",
+                  target_fn: Optional[Callable] = None,
+                  log=None) -> int:
+    """Execute ``job`` on an autoscaled ledger fleet and stream the
+    merged result through the job's own emit/commit path. Returns the
+    number of contigs committed. Raises :class:`FleetDispatchError`
+    when no merged output can be produced.
+
+    The supervisor runs in the caller's (job runner) thread — the
+    gateway holds no extra threads; concurrency across fleet jobs is
+    the daemon's existing per-job runner model."""
+    from racon_tpu.distributed.autoscaler import Autoscaler
+    from racon_tpu.obs.metrics import record_gate
+    from racon_tpu.server.jobs import JobCancelled
+
+    spec = job.spec
+    paths = fleet_paths(state_dir, spec.fingerprint())
+    out_path = os.path.join(paths.ledger_dir, "out.fasta")
+    workers = max(1, int(envspec.read(ENV_GATE_WORKERS)))
+    t0 = time.perf_counter()
+    trace_id = job.trace.trace_id if job.trace else "-"
+    parent_id = job.trace.parent_id if job.trace else 0
+
+    if not os.path.isfile(out_path):
+        for d in (paths.ledger_dir, paths.pool_dir, paths.cas_dir):
+            os.makedirs(d, exist_ok=True)
+        extra_env = {
+            # One on-disk compile cache for every spawned worker: the
+            # first worker pays the cold compile into the pool, every
+            # later (and every replacement) worker starts warm.
+            "RACON_TPU_JAX_CACHE": paths.pool_dir,
+            # Fleet-shared result CAS: workers probe/store per-shard
+            # contig records keyed by shard fingerprint, so a re-run
+            # of this fingerprint polishes nothing.
+            "RACON_TPU_CACHE_DIR": paths.cas_dir,
+        }
+        if trace_ctx:
+            extra_env["RACON_TPU_TRACE_CTX"] = trace_ctx
+        if target_fn is None:
+            # Drive the supervisor from service signals (queue depth,
+            # queue-wait p95, fleet drain rate), not only open shards.
+            from racon_tpu.gateway.policy import service_target
+            ldir = paths.ledger_dir
+
+            def target_fn(open_work, pol):
+                return service_target(open_work, pol, ledger_dir=ldir)
+        scaler = Autoscaler(
+            paths.ledger_dir,
+            worker_cli_argv(spec, paths.ledger_dir, workers),
+            default_max=workers, out=io.BytesIO(), log=log,
+            extra_env=extra_env,
+            target_fn=target_fn,
+            trace_dir=os.path.join(paths.ledger_dir, "obs"))
+        rc = scaler.run()
+        if rc != 0:
+            raise FleetDispatchError(
+                f"[racon_tpu::gate] fleet supervisor for job "
+                f"{job.id} exited {rc} (ledger: {paths.ledger_dir})")
+    if not os.path.isfile(out_path):
+        raise FleetDispatchError(
+            f"[racon_tpu::gate] fleet run for job {job.id} finished "
+            f"without a merged output at {out_path}")
+    with open(out_path, "rb") as fh:
+        blob = fh.read()
+    # Re-commit the merged result through the job's own store in the
+    # same emit-then-commit order polish_job uses: /stream, restart
+    # recovery, and the daemon CAS see a fleet job exactly like a
+    # local one. serve/commit keeps its meaning — "one contig became
+    # durable in this job's store" — whichever path computed it.
+    n = 0
+    committed = len(store.committed)
+    for tid, rec in enumerate(_split_fasta(blob)):
+        if tid < committed:
+            # Adoption/restart: the committed prefix re-emits from the
+            # store byte-for-byte (polish_job's emit_stored contract),
+            # zero recompute.
+            stored = store.read_emitted(tid)
+            if stored is not None:
+                job.emit(stored)
+            n += 1
+            continue
+        if job.cancel.is_set():
+            raise JobCancelled(job.id)
+        maybe_fault("serve/commit")
+        nl = rec.index(b"\n")
+        job.emit(rec)
+        store.commit(tid, bytes(rec[1:nl]), bytes(rec[nl + 1:-1]))
+        n += 1
+    record_gate("fleet_run", job.id, job.tenant, trace_id=trace_id,
+                parent_id=parent_id, decision="fleet",
+                wall_s=round(time.perf_counter() - t0, 6),
+                contigs=n, workers=workers)
+    return n

@@ -483,6 +483,12 @@ def assemble_job_timeline(root: str, trace_id: str) -> Dict:
     ``.part`` sidecars count too: a hard-killed worker never promoted
     its trace, and its spans are exactly the interesting ones.
 
+    Nested ``obs/`` dirs under ``root`` are scanned too: a gateway
+    state dir holds the daemon's trace in ``<root>/obs`` and each
+    fleet run's worker traces in ``<root>/fleet/<fp>/ledger/obs``
+    (docs/GATEWAY.md), and one trace_id spans all of them — nested
+    sources are keyed by their root-relative path.
+
     Returns ``{"trace_id", "n_processes", "n_spans", "sources": {file:
     span count}, "spans": [...]}`` with spans sorted by absolute start
     time (each span gains ``t_abs`` and ``src``). Refuses loudly
@@ -490,37 +496,47 @@ def assemble_job_timeline(root: str, trace_id: str) -> Dict:
     matched spans straddle different ``run_fp`` stamps — merging two
     runs' spans would fabricate a timeline that never happened."""
     obs_dir = obs_dir_for(root)
-    try:
-        names = sorted(os.listdir(obs_dir))
-    except OSError:
-        names = []
+    dirs = [obs_dir]
+    for dirpath, _dirnames, _files in os.walk(root):
+        if os.path.basename(dirpath) == OBS_SUBDIR and \
+                os.path.abspath(dirpath) != os.path.abspath(obs_dir):
+            dirs.append(dirpath)
     spans: List[Dict] = []
     sources: Dict[str, int] = {}
     fps = set()
-    for name in names:
-        if name.endswith(SHARD_SUFFIX) or not (
-                name.endswith(".jsonl") or name.endswith(".jsonl.part")):
-            continue
-        path = os.path.join(obs_dir, name)
-        records, _ = load_jsonl_prefix(path)
-        if not records or records[0].get("ev") != "begin":
-            continue
-        begin = float(records[0].get("unix_time", 0.0))
-        n = 0
-        for rec in records[1:]:
-            if rec.get("ev") != "span" or \
-                    not _span_matches_trace(rec, trace_id):
+    for d in dirs:
+        try:
+            names = sorted(os.listdir(d))
+        except OSError:
+            names = []
+        for name in names:
+            if name.endswith(SHARD_SUFFIX) or not (
+                    name.endswith(".jsonl") or
+                    name.endswith(".jsonl.part")):
                 continue
-            span = dict(rec)
-            span["t_abs"] = round(begin + float(rec.get("t0", 0.0)), 6)
-            span["src"] = name
-            spans.append(span)
-            n += 1
-            fp = rec.get("run_fp")
-            if isinstance(fp, str):
-                fps.add(fp)
-        if n:
-            sources[name] = n
+            path = os.path.join(d, name)
+            src = name if d == obs_dir else \
+                os.path.relpath(path, root)
+            records, _ = load_jsonl_prefix(path)
+            if not records or records[0].get("ev") != "begin":
+                continue
+            begin = float(records[0].get("unix_time", 0.0))
+            n = 0
+            for rec in records[1:]:
+                if rec.get("ev") != "span" or \
+                        not _span_matches_trace(rec, trace_id):
+                    continue
+                span = dict(rec)
+                span["t_abs"] = round(
+                    begin + float(rec.get("t0", 0.0)), 6)
+                span["src"] = src
+                spans.append(span)
+                n += 1
+                fp = rec.get("run_fp")
+                if isinstance(fp, str):
+                    fps.add(fp)
+            if n:
+                sources[src] = n
     if not spans:
         raise FleetObsError(
             f"[racon_tpu::fleet] no span under {obs_dir!r} carries "

@@ -789,6 +789,85 @@ def serve_extras(reg: Optional[MetricsRegistry] = None
     return out
 
 
+# ------------------------------------------------------------ gate plane
+
+#: Gateway lifecycle events -> the counter each lands in. The mapping
+#: IS the gate counter registry: record_gate refuses unknown events,
+#: so a typo'd call site fails loudly instead of minting an
+#: undeclared key.
+_GATE_EVENT_KEYS = {
+    "route_fleet": "gate_routed_fleet",
+    "route_local": "gate_routed_local",
+    "adopt": "gate_adoptions",
+    "fleet_run": "gate_fleet_runs",
+}
+
+
+def record_gate(event: str, job: str, tenant: str,
+                trace_id: str = "-", parent_id: int = 0,
+                reg: Optional[MetricsRegistry] = None,
+                wall_s: Optional[float] = None, **attrs) -> int:
+    """Account one gateway event (racon_tpu/gateway/, docs/GATEWAY.md):
+    ``route_fleet`` / ``route_local`` — the dispatch decision for an
+    accepted job; ``adopt`` — a standby gateway fenced a dead primary
+    and took over its journal; ``fleet_run`` — one fleet execution
+    finished streaming back (its ``wall_s`` accumulates into
+    ``gate_fleet_wall_s``). Each event is a counter bump plus a
+    ``gate`` trace span carrying the job's trace context, so the
+    per-job timeline shows the routing decision between the daemon's
+    ``serve`` spans and the fleet's worker spans. Returns the span
+    id."""
+    reg = reg if reg is not None else _REGISTRY
+    try:
+        key = _GATE_EVENT_KEYS[event]
+    except KeyError:
+        raise ValueError(f"[racon_tpu::metrics] unknown gate event "
+                         f"{event!r}") from None
+    reg.inc(key)
+    if wall_s is not None:
+        reg.inc("gate_fleet_wall_s", float(wall_s))
+        attrs["wall_s"] = round(float(wall_s), 6)
+    return _trace.get_tracer().point("gate", event, job=str(job),
+                                     tenant=str(tenant),
+                                     trace_id=str(trace_id),
+                                     parent_id=int(parent_id), **attrs)
+
+
+def set_gate_fleet_target(n: int,
+                          reg: Optional[MetricsRegistry] = None) -> None:
+    """Set the gateway's fleet sizing gauge — the worker target the
+    service policy (gateway/policy.py) chose on its latest supervisor
+    tick."""
+    reg = reg if reg is not None else _REGISTRY
+    reg.set("gate_fleet_target", int(n))
+
+
+def set_gate_rate(jobs_per_min: float,
+                  compile_skip_s: Optional[float] = None,
+                  reg: Optional[MetricsRegistry] = None) -> None:
+    """Set the gateway throughput gauges (bench metric_version 16):
+    fleet-path jobs/min, and — when measured — the wall seconds a
+    freshly spawned worker skipped by hitting the shared jaxcache warm
+    pool instead of compiling cold."""
+    reg = reg if reg is not None else _REGISTRY
+    reg.set("gate_fleet_jobs_per_min", round(float(jobs_per_min), 4))
+    if compile_skip_s is not None:
+        reg.set("gate_compile_skip_s", round(float(compile_skip_s), 4))
+
+
+def gate_extras(reg: Optional[MetricsRegistry] = None
+                ) -> Dict[str, object]:
+    """The registry's gate_* keys as a JSON-ready dict (bench extras
+    metric_version 16 / obs_report "gateway:" section). Empty when no
+    gateway ran, so plain daemon and CLI runs stay quiet."""
+    reg = reg if reg is not None else _REGISTRY
+    out: Dict[str, object] = {}
+    for k, v in sorted(reg.snapshot().items()):
+        if k.startswith("gate_"):
+            out[k] = round(v, 4) if isinstance(v, float) else v
+    return out
+
+
 # --------------------------------------------------- result cache plane
 
 
@@ -941,6 +1020,11 @@ _MERGE_LAST_KEYS = frozenset({
     # re-derives from the totals on every event, so the most recent
     # snapshot wins — the cache_* hit/miss/store/evict counters sum.
     "cache_hit_ratio",
+    # Gateway gauges (racon_tpu/gateway/): the policy's latest fleet
+    # sizing decision and the bench throughput/compile-skip readings —
+    # the gate_* routed/adoption/run counters sum.
+    "gate_fleet_target", "gate_fleet_jobs_per_min",
+    "gate_compile_skip_s",
 })
 
 
@@ -993,6 +1077,10 @@ METRIC_SPECS = (
     ("fleet_target_workers", MERGE_LAST, "fleet_target_workers"),
     ("flight_dump_write_s", MERGE_SUM, "flight_dump_write_s"),
     ("flight_dumps_total", MERGE_SUM, "flight_dumps_total"),
+    ("gate_compile_skip_s", MERGE_LAST, "gate_compile_skip_s"),
+    ("gate_fleet_jobs_per_min", MERGE_LAST, "gate_fleet_jobs_per_min"),
+    ("gate_fleet_target", MERGE_LAST, "gate_fleet_target"),
+    ("gate_*", MERGE_SUM, "gate_routed_fleet"),
     ("h2d_bytes", MERGE_SUM, "h2d_bytes"),
     ("h2d_s", MERGE_SUM, "h2d_s"),
     ("h2d_transfer_s", MERGE_HIST, "h2d_transfer_s"),

@@ -102,6 +102,7 @@ SITES = (
     "dispatch/chunk", "dispatch/walk",
     "dist/claim", "dist/contig", "dist/merge", "dist/merge_write",
     "dist/shard", "dist/split",
+    "gate/adopt", "gate/route",
     "h2d/align", "h2d/chunk", "h2d/repack",
     "io/inflate", "io/read",
     "obs/flight", "obs/snapshot",

@@ -85,6 +85,7 @@ class PolishServer:
         self._batchers: Dict[Tuple, CrossRequestBatcher] = {}  # guarded-by: _lock
         self._threads: List[threading.Thread] = []  # guarded-by: _lock
         self._n_done = 0                            # guarded-by: _lock
+        self._queued = 0                            # guarded-by: _lock
         self._draining = False                      # guarded-by: _lock
         self._lock = threading.Lock()
         self._sem = threading.BoundedSemaphore(
@@ -236,10 +237,84 @@ class PolishServer:
                     CrossRequestBatcher(engine, memo=memo).start()
             return b
 
+    def _route(self, job: Job, store):
+        """The gateway routing decision for one admitted job
+        (docs/GATEWAY.md): in-process batcher or autoscaled ledger
+        fleet, from the job's target count and the current admission
+        queue depth. Recorded as a ``gate`` span + counter so the
+        per-job timeline shows the decision between submit and run."""
+        from racon_tpu.gateway.dispatch import (count_targets,
+                                                decide_route,
+                                                fleet_enabled,
+                                                fleet_paths)
+        from racon_tpu.obs.metrics import record_gate
+        n_targets = 0
+        if fleet_enabled():
+            try:
+                n_targets = count_targets(job.spec.targets)
+            except Exception:
+                n_targets = 0  # unreadable inputs fail later, locally
+        with self._lock:
+            depth = self._queued
+        decision = decide_route(job.spec, n_targets, depth)
+        if decision.route == "fleet" and store.committed:
+            # A job that started locally (committed prefix but no
+            # fleet run dir) must finish locally: local stores number
+            # every target tid (dropped ones included), fleet replay
+            # numbers emitted contigs densely — mixing the two would
+            # corrupt the resume.
+            run_dir = fleet_paths(self.state_dir,
+                                  job.spec.fingerprint()).run_dir
+            if not os.path.isdir(run_dir):
+                decision = decision._replace(
+                    route="local", reason="resume-local-prefix")
+        record_gate("route_fleet" if decision.route == "fleet"
+                    else "route_local", job.id, job.tenant,
+                    trace_id=job.trace.trace_id if job.trace else "-",
+                    parent_id=job.trace.parent_id if job.trace else 0,
+                    decision=decision.route, reason=decision.reason,
+                    n_targets=decision.n_targets,
+                    queue_depth=decision.queue_depth)
+        return decision
+
+    def _run_fleet(self, job: Job, store) -> None:
+        """Execute one fleet-routed job through the gateway adapter
+        and finish it exactly like a local run (same journal states,
+        same CAS store, same gauges)."""
+        from racon_tpu.gateway.dispatch import run_fleet_job
+        state, error = "done", None
+        try:
+            run_fleet_job(
+                job, self.state_dir, store,
+                trace_ctx=job.trace.encode() if job.trace else "",
+                log=sys.stderr)
+        except JobCancelled:
+            state = "cancelled"
+        except Exception as exc:
+            state, error = "failed", str(exc)
+        else:
+            if self.cache is not None:
+                # Same Tier-1 store as the local path: a resubmission
+                # of this fingerprint replays from the daemon CAS
+                # without touching the fleet at all.
+                try:
+                    self.cache.store(job.spec.fingerprint(),
+                                     records_from_store(store))
+                except Exception as exc:
+                    print(f"[racon_tpu::serve] cache store failed "
+                          f"for job {job.id}: {exc}", file=sys.stderr)
+        job.n_committed = len(store.committed)
+        store.close()
+        self._finish(job, state, error)
+
     def _run_job(self, job: Job) -> None:
         from racon_tpu.obs.metrics import record_hist
         from racon_tpu.resilience.faults import maybe_fault
+        with self._lock:
+            self._queued += 1
         with self._sem:
+            with self._lock:
+                self._queued -= 1
             if job.t_submit:
                 record_hist("serve_queue_wait_s",
                             time.perf_counter() - job.t_submit)
@@ -275,6 +350,10 @@ class PolishServer:
                     store.close()
                     self._finish(job, "done", None)
                     return
+            decision = self._route(job, store)
+            if decision.route == "fleet":
+                self._run_fleet(job, store)
+                return
             proxy = BatchedEngineProxy(self._batcher_for(job.spec),
                                        job.id, job.tenant,
                                        trace=job.trace)
@@ -463,6 +542,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--port", type=int, default=0,
                         help="HTTP port (0 = ephemeral; the bound port "
                              "is published to <state-dir>/port)")
+    parser.add_argument("--standby", action="store_true",
+                        help="block until the gateway lease over "
+                             "state-dir can be acquired (adopting a "
+                             "dead primary's in-flight jobs), instead "
+                             "of failing when one is held")
     args = parser.parse_args(argv)
 
     from racon_tpu.obs.metrics import registry
@@ -473,12 +557,66 @@ def main(argv: Optional[List[str]] = None) -> int:
     # pipeline stages — the dispatcher must stay the only device owner.
     configure_pipeline(0)
 
+    # Gateway lease (racon_tpu/gateway/ha.py): exactly one daemon owns
+    # a state dir at a time. The primary first-claims (or steals an
+    # expired lease); a --standby replica blocks here until the
+    # primary dies or hands off, then ADOPTS: recover() below re-queues
+    # the dead primary's journaled in-flight jobs.
+    from racon_tpu.gateway.ha import GatewayLease, GatewayLeaseLost
+    from racon_tpu.obs.metrics import record_gate
+    os.makedirs(args.state_dir, exist_ok=True)
+    lease = GatewayLease(args.state_dir, owner=f"gw{os.getpid()}")
+    if args.standby:
+        lease.acquire()
+    elif not lease.try_acquire():
+        print(f"[racon_tpu::serve] another gateway holds the lease on "
+              f"{args.state_dir} (use --standby to wait and adopt)",
+              file=sys.stderr)
+        return 1
+    if lease.adopted:
+        print(f"[racon_tpu::serve] adopted state dir "
+              f"{args.state_dir} from a dead primary (lease epoch "
+              f"{lease.epoch})", file=sys.stderr)
+
     server = PolishServer(args.state_dir)
     server.session.activate()
     resumed = server.recover()
+    if lease.adopted:
+        # One adopt event per journaled in-flight job taken over — the
+        # jobs' own trace contexts make the adoption visible in each
+        # per-job timeline.
+        adopted_jobs = [j for j in server.describe()["jobs"]
+                        if j["state"] in ("queued", "running")]
+        if adopted_jobs:
+            for st in adopted_jobs:
+                job = server.get(st["id"])
+                record_gate("adopt", job.id, job.tenant,
+                            trace_id=job.trace.trace_id if job.trace
+                            else "-",
+                            parent_id=job.trace.parent_id if job.trace
+                            else 0, epoch=lease.epoch)
+        else:
+            record_gate("adopt", "-", "-", epoch=lease.epoch)
     if resumed:
         print(f"[racon_tpu::serve] resumed {resumed} in-flight "
               f"job(s)", file=sys.stderr)
+
+    # Renewal loop: push the lease deadline out well inside the term;
+    # the moment our nonce is gone (a standby fenced us) the only safe
+    # reaction is a hard exit — keeping the journal would double-run
+    # every job the adopter now owns.
+    lease_stop = threading.Event()
+
+    def _renew_loop():
+        while not lease_stop.wait(max(0.05, lease.lease_s / 3.0)):
+            try:
+                lease.renew()
+            except GatewayLeaseLost as exc:
+                print(str(exc), file=sys.stderr)
+                os._exit(75)
+
+    threading.Thread(target=_renew_loop, name="gateway-lease",
+                     daemon=True).start()
 
     try:
         httpd = serve_http(server, args.host, args.port)
@@ -506,6 +644,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     print("[racon_tpu::serve] draining...", file=sys.stderr)
     httpd.shutdown()
     clean = server.drain()
+    # Cooperative handoff: a released lease lets the next daemon claim
+    # instantly and tells it the jobs were drained, not orphaned.
+    lease_stop.set()
+    try:
+        lease.release()
+    except OSError:
+        pass
     # Flight recorder dump (obs/flightrec.py): lands beside the fleet
     # obs dir when RACON_TPU_OBS_DIR is set, else a silent no-op.
     from racon_tpu.obs import flightrec

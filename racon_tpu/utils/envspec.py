@@ -104,6 +104,25 @@ declare("RACON_TPU_SPLIT_AFTER_S", "", "float", "DISTRIBUTED.md",
 declare("RACON_TPU_SPLIT_DEPTH", "", "int", "DISTRIBUTED.md",
         "max split lineage depth (guards handoff cascades)")
 
+# docs/GATEWAY.md — fleet-serve gateway
+declare("RACON_TPU_GATE_FLEET", "0", "flag", "GATEWAY.md",
+        "fleet-serve gate: route eligible daemon jobs to an "
+        "autoscaled ledger fleet (default off = all jobs in-process)")
+declare("RACON_TPU_GATE_FLEET_MIN_TARGETS", "32", "int", "GATEWAY.md",
+        "routing size threshold: jobs with at least this many target "
+        "contigs go to the fleet")
+declare("RACON_TPU_GATE_LEASE_S", "10", "float", "GATEWAY.md",
+        "gateway lease term; a standby adopts after a primary misses "
+        "renewals for this long")
+declare("RACON_TPU_GATE_QUEUE_PRESSURE", "8", "int", "GATEWAY.md",
+        "queue-pressure override: at this admission-queue depth even "
+        "small jobs route to the fleet")
+declare("RACON_TPU_GATE_STANDBY_POLL_S", "0.2", "float", "GATEWAY.md",
+        "standby gateway lease poll interval")
+declare("RACON_TPU_GATE_WORKERS", "2", "int", "GATEWAY.md",
+        "fleet size cap per gateway-dispatched job (the autoscale "
+        "max the supervisor is started with)")
+
 # docs/INGEST.md — parallel data plane
 declare("RACON_TPU_INGEST", "", "flag", "INGEST.md",
         "parallel ingest gate: chunked inflate + mmap readers "

@@ -1,0 +1,364 @@
+"""CI smoke: the fleet-serve gateway end to end through real processes
+(racon_tpu/gateway/, docs/GATEWAY.md).
+
+One daemon becomes a sharded service gateway: an armed routing policy
+ships big jobs to an autoscaled ledger fleet (worker subprocesses over
+a nonce-fenced WorkLedger) and keeps small ones on the in-process
+batcher, with every streamed byte asserted identical to a solo serial
+CLI run.
+
+Phases:
+
+A. **Routed fleet under fire** — 3 concurrent jobs from 2 tenants:
+   two route to the fleet (4 targets >= RACON_TPU_GATE_FLEET_MIN_-
+   TARGETS=2), one stays local (1 target). The autoscaler fault plan
+   hard-kills each fleet's first worker mid-job (``dist/contig:1!kill``
+   → ``os._exit(137)``); the supervisor replaces it and the replacement
+   steals the orphaned shard. All three streams byte-diff clean, the
+   gate_* counters tell the routes apart, and /metrics validates.
+B. **Resubmit = CAS hit** — the same spec resubmitted is served from
+   the daemon's result CAS without a second fleet dispatch.
+C. **Warm pool** — a fresh fleet job's freshly spawned worker attaches
+   to the shared jaxcache pool populated in phase A: its metric shard
+   records the pool's entry count at start, and the pool gains zero
+   entries (every compile was a hit).
+D. **Gateway kill drill** — a fresh primary is hard-killed mid-commit
+   (``serve/commit:1!kill``) while holding the gateway lease; a
+   ``--standby`` replica (skewed clock, same discipline as the shard
+   ledger drills) adopts the state dir, re-queues the in-flight job,
+   replays the committed prefix from its store, short-circuits on the
+   already-merged ledger output, and streams byte-identical.
+
+Plus: one trace id spans gateway → supervisor → workers —
+``obs_report.py <state> --job <trace_id>`` stitches gate spans and
+worker spans into one timeline.
+"""
+
+import io
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np                                   # noqa: E402
+
+BASES = np.frombuffer(b"ACGT", np.uint8)
+BOOT = ("import sys; from racon_tpu import cli; "
+        "sys.exit(cli.main(sys.argv[1:]))")
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SCRUB_ENVS = ("RACON_TPU_FAULTS", "RACON_TPU_TRACE",
+              "RACON_TPU_TRACE_CTX", "RACON_TPU_OBS_DIR",
+              "RACON_TPU_GATE_FLEET", "RACON_TPU_GATE_FLEET_MIN_TARGETS",
+              "RACON_TPU_GATE_WORKERS", "RACON_TPU_GATE_LEASE_S",
+              "RACON_TPU_AUTOSCALE_FAULT_PLAN", "RACON_TPU_CACHE_DIR",
+              "RACON_TPU_JAX_CACHE")
+
+
+def _noisy(rng, truth):
+    out = []
+    for b in truth:
+        r = rng.random()
+        if r < 0.03:
+            continue
+        out.append(int(rng.integers(0, 4)) if r < 0.06 else int(
+            np.searchsorted(BASES, b)))
+    return bytes(BASES[np.array(out)])
+
+
+def _write_inputs(d, n_contigs, seed):
+    rng = np.random.default_rng(seed)
+    drafts, reads, paf = [], [], []
+    for c in range(n_contigs):
+        truth = BASES[rng.integers(0, 4, 300 + 40 * c)]
+        draft = _noisy(rng, truth)
+        drafts.append(b">c%d\n%s\n" % (c, draft))
+        for i in range(6):
+            r = _noisy(rng, truth)
+            rid = f"r{c}_{i}"
+            reads.append(b">%s\n%s\n" % (rid.encode(), r))
+            paf.append(f"{rid}\t{len(r)}\t0\t{len(r)}\t+\tc{c}"
+                       f"\t{len(draft)}\t0\t{len(draft)}"
+                       f"\t{min(len(r), len(draft))}"
+                       f"\t{max(len(r), len(draft))}\t60")
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "draft.fasta"), "wb") as fh:
+        fh.write(b"".join(drafts))
+    with open(os.path.join(d, "reads.fasta"), "wb") as fh:
+        fh.write(b"".join(reads))
+    with open(os.path.join(d, "ovl.paf"), "w") as fh:
+        fh.write("\n".join(paf) + "\n")
+
+
+def _env(**overrides):
+    e = dict(os.environ)
+    for k in SCRUB_ENVS:
+        e.pop(k, None)
+    e.update(overrides)
+    return e
+
+
+def _solo_cli(d):
+    proc = subprocess.run(
+        [sys.executable, "-c", BOOT, "--backend", "jax",
+         os.path.join(d, "reads.fasta"), os.path.join(d, "ovl.paf"),
+         os.path.join(d, "draft.fasta")],
+        capture_output=True, env=_env(), cwd=ROOT)
+    assert proc.returncode == 0, proc.stderr.decode()
+    return proc.stdout
+
+
+# ------------------------------------------------------------ daemon ops
+
+
+def _start_daemon(state, env=None, standby=False):
+    e = _env(**(env or {}))
+    os.makedirs(state, exist_ok=True)
+    port_file = os.path.join(state, "port")
+    if os.path.exists(port_file):
+        os.remove(port_file)
+    argv = [sys.executable, "-m", "racon_tpu.server", "--state-dir",
+            state, "--port", "0"]
+    if standby:
+        argv.append("--standby")
+    proc = subprocess.Popen(argv, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.PIPE, env=e, cwd=ROOT)
+    deadline = time.monotonic() + 180
+    while not os.path.exists(port_file):
+        if proc.poll() is not None:
+            raise AssertionError("daemon died on startup:\n" +
+                                 proc.stderr.read().decode())
+        if time.monotonic() > deadline:
+            proc.kill()
+            raise AssertionError("daemon never published its port")
+        time.sleep(0.05)
+    with open(port_file) as fh:
+        port = int(fh.read().strip())
+    return proc, port
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30) as resp:
+        return resp.read()
+
+
+def _submit(port, tenant, d):
+    body = json.dumps({
+        "tenant": tenant,
+        "sequences": os.path.join(d, "reads.fasta"),
+        "overlaps": os.path.join(d, "ovl.paf"),
+        "targets": os.path.join(d, "draft.fasta"),
+        "options": {"backend": "jax"}}).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/jobs", data=body, method="POST")
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return json.loads(resp.read())["id"]
+
+
+def _wait_done(port, job_id, timeout_s=600):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        status = json.loads(_get(port, f"/v1/jobs/{job_id}"))
+        if status["state"] in ("done", "failed", "cancelled"):
+            assert status["state"] == "done", status
+            return status
+        time.sleep(0.2)
+    raise AssertionError(f"job {job_id} did not finish in {timeout_s}s")
+
+
+def _metric(text, key):
+    m = re.search(rf"^racon_tpu_{key}(?:_total)? (\S+)$", text,
+                  re.MULTILINE)
+    return float(m.group(1)) if m else None
+
+
+def _pool_entries(pool):
+    try:
+        return sum(1 for e in os.scandir(pool) if e.is_file())
+    except OSError:
+        return 0
+
+
+def _fleet_run_dirs(state):
+    root = os.path.join(state, "fleet")
+    return [os.path.join(root, n) for n in sorted(os.listdir(root))
+            if n not in ("jaxcache", "cas") and
+            os.path.isdir(os.path.join(root, n, "ledger"))]
+
+
+def main():
+    from racon_tpu.obs.export import validate_openmetrics
+    from racon_tpu.obs import fleet as obs_fleet
+
+    with tempfile.TemporaryDirectory() as d:
+        dirs = {
+            "a": (os.path.join(d, "inA"), 4, 11),   # fleet, acme
+            "b": (os.path.join(d, "inB"), 4, 22),   # fleet, umbrella
+            "c": (os.path.join(d, "inC"), 1, 33),   # local (1 target)
+            "e": (os.path.join(d, "inE"), 4, 44),   # fleet, warm drill
+            "f": (os.path.join(d, "inF"), 2, 55),   # fleet, kill drill
+        }
+        refs = {}
+        for key, (di, n, seed) in dirs.items():
+            _write_inputs(di, n, seed)
+            refs[key] = _solo_cli(di)
+            assert refs[key].count(b">") == n, key
+
+        # --- phase A: routed fleet under fire -------------------------
+        s1 = os.path.join(d, "s1")
+        os.makedirs(os.path.join(s1, "obs"), exist_ok=True)
+        pool = os.path.join(s1, "fleet", "jaxcache")
+        gate_env = {
+            "RACON_TPU_GATE_FLEET": "1",
+            "RACON_TPU_GATE_FLEET_MIN_TARGETS": "2",
+            "RACON_TPU_GATE_WORKERS": "2",
+            "RACON_TPU_AUTOSCALE_INTERVAL_S": "0.2",
+            "RACON_TPU_TRACE": os.path.join(s1, "obs", "daemon.jsonl"),
+        }
+        # Each fleet's first spawned worker is hard-killed at its 2nd
+        # contig; the supervisor must replace it and the replacement
+        # must steal the orphaned shard.
+        plan = os.path.join(d, "fault_plan.json")
+        with open(plan, "w") as fh:
+            json.dump(["dist/contig:1!kill"], fh)
+        proc, port = _start_daemon(s1, env=dict(
+            gate_env, RACON_TPU_AUTOSCALE_FAULT_PLAN=plan))
+        j1 = _submit(port, "acme", dirs["a"][0])
+        j2 = _submit(port, "umbrella", dirs["b"][0])
+        j3 = _submit(port, "acme", dirs["c"][0])
+        st1 = _wait_done(port, j1)
+        _wait_done(port, j2)
+        _wait_done(port, j3)
+        for jid, key in ((j1, "a"), (j2, "b"), (j3, "c")):
+            assert _get(port, f"/v1/jobs/{jid}/stream") == refs[key], \
+                f"job {jid} ({key}) differs from solo serial CLI"
+        text = _get(port, "/metrics").decode()
+        errs = validate_openmetrics(text)
+        assert not errs, "invalid /metrics:\n" + "\n".join(errs)
+        assert _metric(text, "gate_routed_fleet") == 2, text
+        assert _metric(text, "gate_routed_local") == 1, text
+        assert _metric(text, "gate_fleet_runs") == 2, text
+        assert _metric(text, "gate_fleet_target") is not None, \
+            "service-signal autoscaling published no gate_fleet_target"
+        evicted = 0
+        for run in _fleet_run_dirs(s1):
+            hb = os.path.join(run, "ledger", "obs", "autoscaler.json")
+            with open(hb) as fh:
+                evicted += json.loads(fh.readline())["evicted_total"]
+        assert evicted >= 2, \
+            f"expected both fleets' first workers hard-killed, " \
+            f"saw {evicted} eviction(s)"
+        assert _pool_entries(pool) > 0, \
+            "fleet workers populated no shared compile-cache pool"
+        print(f"[fleet-serve-smoke] A: 2 fleet + 1 local jobs "
+              f"byte-identical across 2 tenants; {evicted} worker "
+              f"kill(s) absorbed; pool holds "
+              f"{_pool_entries(pool)} entr(ies)", flush=True)
+
+        # --- phase B: resubmit = CAS hit, no second dispatch ----------
+        j4 = _submit(port, "acme", dirs["a"][0])
+        _wait_done(port, j4)
+        assert _get(port, f"/v1/jobs/{j4}/stream") == refs["a"]
+        text = _get(port, "/metrics").decode()
+        assert _metric(text, "gate_routed_fleet") == 2, \
+            "resubmitted job dispatched a second fleet run instead " \
+            "of hitting the daemon CAS"
+        print("[fleet-serve-smoke] B: resubmit served from the result "
+              "CAS, fleet not re-dispatched", flush=True)
+
+        # --- phase C: freshly spawned worker hits the warm pool -------
+        entries = _pool_entries(pool)
+        before = set(_fleet_run_dirs(s1))
+        j5 = _submit(port, "umbrella", dirs["e"][0])
+        _wait_done(port, j5)
+        assert _get(port, f"/v1/jobs/{j5}/stream") == refs["e"]
+        assert _pool_entries(pool) == entries, \
+            f"warm-pool miss: {_pool_entries(pool) - entries} fresh " \
+            "compile(s) escaped the shared jaxcache"
+        run5 = sorted(set(_fleet_run_dirs(s1)) - before)
+        assert len(run5) == 1, \
+            f"expected exactly one new fleet run dir, got {run5}"
+        shards = obs_fleet.load_worker_shards(
+            os.path.join(run5[0], "ledger", "obs"))
+        starts = [sh["records"][-1]["metrics"].get(
+            "jax_cache_entries_start", 0) for sh in shards]
+        assert any(s == entries for s in starts), \
+            f"no spawned worker recorded the warm pool at start " \
+            f"(pool {entries}, workers saw {starts})"
+        print(f"[fleet-serve-smoke] C: fresh worker started against "
+              f"{entries} pooled executable(s), 0 added", flush=True)
+
+        # --- one trace id: gateway -> supervisor -> workers -----------
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=180)
+        assert rc == 0, proc.stderr.read().decode()
+        trace_id = st1["trace"].split(":")[0]
+        from scripts import obs_report
+        buf = io.StringIO()
+        assert obs_report._render_job(s1, trace_id, out=buf) == 0
+        tl_text = buf.getvalue()
+        m = re.search(r"across (\d+) process", tl_text)
+        assert m and int(m.group(1)) >= 2, tl_text
+        assert "gate/route_fleet" in tl_text, tl_text
+        assert "gate/fleet_run" in tl_text, tl_text
+        assert "decision=fleet" in tl_text, tl_text
+        assert re.search(r"worker_as\d", tl_text), \
+            "no autoscaled worker spans joined the job timeline:\n" + \
+            tl_text
+        print(f"[fleet-serve-smoke] timeline: job {trace_id} spans "
+              f"{m.group(1)} processes incl. gate spans", flush=True)
+
+        # --- phase D: gateway kill drill with standby adoption --------
+        s2 = os.path.join(d, "s2")
+        d_env = {
+            "RACON_TPU_GATE_FLEET": "1",
+            "RACON_TPU_GATE_FLEET_MIN_TARGETS": "2",
+            "RACON_TPU_GATE_WORKERS": "1",
+            "RACON_TPU_AUTOSCALE_INTERVAL_S": "0.2",
+        }
+        primary, port = _start_daemon(s2, env=dict(
+            d_env, RACON_TPU_FAULTS="serve/commit:1!kill"))
+        j6 = _submit(port, "acme", dirs["f"][0])
+        rc = primary.wait(timeout=600)
+        assert rc == 137, \
+            f"expected the primary hard-killed mid-commit (137), " \
+            f"got {rc}: {primary.stderr.read().decode()}"
+        # The fleet finished merging before the kill; the job's store
+        # holds exactly the first committed contig.
+        man = os.path.join(s2, "jobs", j6, "ckpt", "manifest.jsonl")
+        committed = sum(1 for line in open(man)
+                        if json.loads(line).get("ev") == "contig")
+        assert committed == 1, \
+            f"expected 1 committed contig at the kill, {committed}"
+        # Standby with a skewed clock (the ledger drills' instant-steal
+        # idiom): adopts the dead primary's lease, re-queues the job.
+        standby, port = _start_daemon(
+            s2, env=dict(d_env, RACON_TPU_FAULTS="skew=99999"),
+            standby=True)
+        _wait_done(port, j6)
+        assert _get(port, f"/v1/jobs/{j6}/stream") == refs["f"], \
+            "adopted job differs from solo serial CLI"
+        text = _get(port, "/metrics").decode()
+        assert _metric(text, "gate_adoptions") == 1, text
+        standby.send_signal(signal.SIGTERM)
+        rc = standby.wait(timeout=180)
+        assert rc == 0, standby.stderr.read().decode()
+        print(f"[fleet-serve-smoke] D: primary killed mid-commit "
+              f"({committed} contig durable), standby adopted the "
+              f"lease, replayed the prefix, finished byte-identical",
+              flush=True)
+
+    print("[fleet-serve-smoke] PASS", flush=True)
+
+
+if __name__ == "__main__":
+    main()

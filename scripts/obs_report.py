@@ -75,6 +75,12 @@ KIND_REQUIRED_ATTRS = {
     # outcome (hit/miss/store/evict/verify_fail) — per-window probes
     # arrive batched, one point per chunk.
     "cache": ("tier", "outcome"),
+    # One fleet-serve gateway event (racon_tpu/gateway/ via
+    # obs/metrics.py record_gate): a routing decision
+    # (route_fleet/route_local), a standby adoption, or a finished
+    # fleet execution — same trace-context attrs as serve points, so
+    # the per-job timeline shows gateway → supervisor → workers.
+    "gate": ("job", "tenant", "trace_id", "parent_id"),
 }
 
 # Span kinds that carry no required attributes — structural intervals
@@ -585,6 +591,12 @@ def _render_job(root: str, trace_id: str, out=None) -> int:
         extra = ""
         if s.get("kind") == "serve":
             extra = f"  job={s.get('job')} tenant={s.get('tenant')}"
+        elif s.get("kind") == "gate":
+            extra = f"  job={s.get('job')} tenant={s.get('tenant')}"
+            if s.get("decision"):
+                extra += f" decision={s.get('decision')}"
+            if s.get("reason"):
+                extra += f" reason={s.get('reason')}"
         elif "worker_id" in s:
             extra = f"  worker={s['worker_id']}"
         print(f"{s['t_abs'] - t_base:>9.3f}  {s['dur_s']:>8.3f}  "
