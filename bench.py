@@ -377,6 +377,108 @@ def _fleet_serve_bench(coverage: int, wlen: int) -> dict:
     return out
 
 
+def _ava_child(n_reads: int, out_path: str) -> int:
+    """Child half of _ava_bench (``python bench.py --ava-child N OUT``):
+    synthesize an ava read set (the same skewed family generator the CI
+    smoke uses), run one serial kF correction through the real CLI with
+    a checkpoint store (v2 segmented manifests — the fragment_correction
+    default), and report wall, peak RSS and manifest accounting as JSON.
+    Runs in its own interpreter so ru_maxrss is THIS workload's peak,
+    not the parent bench's."""
+    import resource
+    import tempfile
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    from scripts.ava_scale_smoke import _write_inputs
+    from racon_tpu import cli
+
+    with tempfile.TemporaryDirectory() as d:
+        _write_inputs(d, n_reads)
+        reads = os.path.join(d, "reads.fasta")
+        ckpt = os.path.join(d, "ckpt")
+        corrected = os.path.join(d, "corrected.fasta")
+        # The CLI emits on stdout; route fd 1 to a file for the drill.
+        sink = os.open(corrected, os.O_WRONLY | os.O_CREAT | os.O_TRUNC)
+        sys.stdout.flush()
+        old_stdout = os.dup(1)
+        os.dup2(sink, 1)
+        os.close(sink)
+        try:
+            t0 = time.perf_counter()
+            rc = cli.main(["--backend", "jax", "-f",
+                           "--checkpoint-dir", ckpt,
+                           reads, os.path.join(d, "ava.paf"), reads])
+            dt = time.perf_counter() - t0
+            sys.stdout.flush()
+        finally:
+            os.dup2(old_stdout, 1)
+            os.close(old_stdout)
+        assert rc == 0, f"ava child CLI exited {rc}"
+        emitted = open(corrected, "rb").read().count(b">")
+        assert emitted == n_reads, \
+            f"ava child corrected {emitted}/{n_reads} reads"
+        manifest = open(os.path.join(ckpt, "manifest.jsonl"),
+                        "rb").read()
+        recs = [json.loads(ln) for ln in manifest.splitlines()]
+        assert recs and recs[0].get("manifest") == 2, \
+            f"kF checkpoint store is not v2: {recs[:1]}"
+        segs = [r for r in recs[1:] if r.get("ev") == "seg"]
+        assert len(segs) == len(recs) - 1, \
+            "per-target records in a v2 manifest"
+        rss_mb = resource.getrusage(
+            resource.RUSAGE_SELF).ru_maxrss / 1024.0
+        with open(out_path, "w", encoding="utf-8") as fh:
+            json.dump({"dt": dt, "n_reads": n_reads,
+                       "manifest_bytes": len(manifest),
+                       "seg_records": len(segs),
+                       "peak_rss_mb": round(rss_mb, 2)}, fh)
+    return 0
+
+
+def _ava_bench() -> dict:
+    """Assembly-scale ava micro-bench (metric_version 17): one serial
+    kF correction of a skewed read set through the real CLI in a fresh
+    interpreter (so peak RSS is the workload's own), checkpointed
+    through a v2 segmented manifest store. Publishes ava_reads_per_sec
+    (corrected reads per wall second), ava_peak_rss_mb, and
+    ava_manifest_bytes_per_target — the o(1)-records acceptance number
+    v1's one-record-per-target format cannot reach — and asserts the
+    segment amortization outright (records * 8 <= targets)."""
+    import subprocess
+    import tempfile
+    from racon_tpu.obs import metrics as obs_metrics
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    n_reads = 600
+    with tempfile.TemporaryDirectory() as d:
+        res_path = os.path.join(d, "ava.json")
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        p = subprocess.run(
+            [sys.executable, os.path.join(repo, "bench.py"),
+             "--ava-child", str(n_reads), res_path],
+            cwd=repo, env=env, capture_output=True, text=True,
+            timeout=600)
+        assert p.returncode == 0, \
+            f"ava bench child failed:\n{p.stderr[-2000:]}"
+        with open(res_path, "r", encoding="utf-8") as fh:
+            r = json.load(fh)
+
+    assert r["seg_records"] * 8 <= n_reads, \
+        f"{r['seg_records']} manifest records for {n_reads} targets — " \
+        "segment amortization failed"
+    reads_per_sec = n_reads / r["dt"]
+    obs_metrics.set_ava_bench(reads_per_sec, r["peak_rss_mb"],
+                              r["manifest_bytes"] / n_reads)
+    out = dict(obs_metrics.ava_extras())
+    out["ava_bench_reads"] = n_reads
+    out["ava_bench_seconds"] = round(r["dt"], 4)
+    out["ava_bench_seg_records"] = r["seg_records"]
+    return out
+
+
 def main():
     from racon_tpu.utils.jaxcache import enable_compile_cache
     enable_compile_cache()
@@ -625,14 +727,35 @@ def main():
     # serve_jobs_per_min re-base (same-workload single-daemon
     # baseline) must win.
     fleet_serve_extras = _fleet_serve_bench(coverage, wlen)
+    # Ava drill runs serially in its own interpreter (peak RSS must be
+    # the kF workload's own, not this process's accumulated footprint).
+    ava_bench_extras = _ava_bench()
     extras = {**sched_extras, **e2e_transfers, **pipe_extras,
               **walk_bench_extras, **probe_extras, **adaptive_extras,
               **cache_extras(), **obs_metrics.resilience_extras(),
               **obs_metrics.ovl_extras(), **obs_metrics.dist_extras(),
               **obs_metrics.redo_extras(), **obs_metrics.ingest_extras(),
               **ingest_bench_extras, **serve_bench_extras,
-              **cache_bench_extras, **fleet_serve_extras, **dp_extras}
+              **cache_bench_extras, **fleet_serve_extras,
+              **ava_bench_extras, **dp_extras}
     out = {
+        # metric_version 17: same primary value as versions 2-16 (the
+        # compute bench is untouched — ava workload planning shapes
+        # which windows batch together and how results are
+        # checkpointed, it never changes what the engine computes per
+        # window). New in 17: the assembly-scale ava extras
+        # (_ava_bench; one serial kF fragment correction of a
+        # length-skewed read set through the real CLI in a fresh
+        # interpreter, checkpointed through a v2 segmented manifest
+        # store) — ava_reads_per_sec (corrected reads per wall
+        # second), ava_peak_rss_mb (the child's own ru_maxrss),
+        # ava_manifest_bytes_per_target (v2 segment amortization; v1's
+        # per-target records hold this ~100 at any scale), plus
+        # ava_bench_reads / ava_bench_seconds / ava_bench_seg_records
+        # describing the drill, and the ava_* plan gauges
+        # (ava_targets / ava_buckets / ava_quantum /
+        # ava_compile_budget / ava_pad_frac) when a fleet run planned
+        # shapes in-process — see docs/AVA.md.
         # metric_version 16: same primary value as versions 2-15 (the
         # compute bench is untouched — the gateway routes jobs around
         # the engine, it never changes what the engine computes). New
@@ -784,7 +907,7 @@ def main():
         # fixed_engine_windows_per_sec. Bump this whenever the primary
         # value's definition changes, so round-over-round comparisons
         # can't silently mix metrics.
-        "metric_version": 16,
+        "metric_version": 17,
         "metric": f"POA windows/sec/chip, compute-only (direct-timed warm "
                   f"production chunk, convergence-scheduled refinement "
                   f"rounds — racon_tpu/sched/, telemetry in sched_* "
@@ -827,4 +950,6 @@ def main():
 
 
 if __name__ == "__main__":
+    if sys.argv[1:2] == ["--ava-child"]:
+        sys.exit(_ava_child(int(sys.argv[2]), sys.argv[3]))
     main()

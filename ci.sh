@@ -92,4 +92,7 @@ python scripts/job_trace_smoke.py
 echo "[ci] fleet serve smoke (gateway routing, worker kill, warm pool, standby adoption, byte-diff)"
 python scripts/fleet_serve_smoke.py
 
+echo "[ci] ava scale smoke (10k-read kF fleet, 1 eviction, weighted bounds, v2 manifests, byte-diff)"
+python scripts/ava_scale_smoke.py
+
 echo "[ci] OK"

@@ -1,0 +1,69 @@
+"""Assembly-scale all-vs-all (ava) workload planning.
+
+Racon's second mode (``-f``, fragment correction — the paper's kF
+configuration) makes EVERY read a target: millions of short,
+length-diverse targets per run instead of the kC regime's
+tens-to-hundreds of large contigs. The rest of the system is shaped
+for kC; this package holds the pieces that open the ava regime without
+forking any execution path (docs/AVA.md):
+
+- :mod:`racon_tpu.ava.partition` — length-weighted shard bounds over
+  the ledger's published ``scan_sequence_index`` offsets, so 10M short
+  reads shard by bytes of work, not by record count
+  (``WorkLedger.open`` consults it whenever offsets are available);
+- :mod:`racon_tpu.ava.planner` — greedy run-level shape buckets
+  layered over the ops/budget.py tile tiers, publishing a compile
+  count against ``RACON_TPU_AVA_COMPILE_BUDGET`` so read-length
+  diversity can't explode compilation;
+- :mod:`racon_tpu.ava.emit` — the streaming record spool the daemon's
+  result path uses so millions of emitted records never materialize
+  as millions of live Python objects;
+- segment sizing for the v2 checkpoint manifest
+  (resilience/checkpoint.py): :func:`seg_targets_for` below decides
+  how many committed targets amortize into one run-length manifest
+  record.
+
+An ava job is still an ordinary :class:`~racon_tpu.server.engine`
+JobSpec with ``fragment_correction=True`` — it rides the existing
+submit → route → ledger path unchanged; only the planning decisions
+above switch with the workload shape.
+"""
+
+from __future__ import annotations
+
+from racon_tpu.utils import envspec
+
+#: Targets per v2 manifest segment when the env leaves it to us: large
+#: enough that a 10M-target run writes ~40k manifest records instead
+#: of 10M, small enough that a crash recomputes at most one segment.
+DEFAULT_SEG_TARGETS = 256
+
+ENV_AVA_SEG = "RACON_TPU_AVA_SEG"
+
+
+def seg_targets_for(fragment_correction: bool) -> int:
+    """Checkpoint-manifest segment size for a run: ``0`` keeps the v1
+    one-record-per-target manifest. Unset defaults to segmented for
+    ava runs (every read is a target — per-target manifest records are
+    exactly what cannot survive that scale) and v1 for kC polishing;
+    an explicit ``RACON_TPU_AVA_SEG`` value wins in either mode."""
+    raw = envspec.read(ENV_AVA_SEG).strip()
+    if raw:
+        try:
+            return max(0, int(raw))
+        except ValueError:
+            return 0
+    return DEFAULT_SEG_TARGETS if fragment_correction else 0
+
+
+from racon_tpu.ava.emit import RecordSpool, iter_fasta_records  # noqa: E402
+from racon_tpu.ava.partition import (weighted_bounds,  # noqa: E402
+                                     weights_from_offsets)
+from racon_tpu.ava.planner import BucketPlan, plan_buckets  # noqa: E402
+
+__all__ = [
+    "DEFAULT_SEG_TARGETS", "ENV_AVA_SEG", "seg_targets_for",
+    "RecordSpool", "iter_fasta_records",
+    "weighted_bounds", "weights_from_offsets",
+    "BucketPlan", "plan_buckets",
+]

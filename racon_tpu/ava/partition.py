@@ -1,0 +1,104 @@
+"""Length-weighted shard partitioning for all-vs-all target sets.
+
+``distributed/ledger._partition`` balances shard bounds by target
+COUNT — the right call for kC polishing, where contigs are few and
+comparably sized. In the ava regime (``-f``) the targets are reads:
+millions of them, with length distributions that routinely span two
+orders of magnitude, so count-balanced shards can differ 10x in actual
+work. The ledger already publishes per-target byte offsets
+(``scan_sequence_index``) in ``meta.json``; this module turns those
+offsets into per-target byte weights and cuts contiguous shard bounds
+at equal-weight points instead of equal-count points.
+
+The contract (docs/AVA.md "Weighted partition"):
+
+- bounds are still contiguous and ascending over ``[0, n_targets]`` —
+  every invariant downstream of ``_partition`` (manifest-as-prefix
+  resume, split carving, the merge's tiling check) holds unchanged;
+- every shard owns at least one target (``n_shards`` is pre-clamped to
+  ``n_targets`` by the caller, as for the count partition);
+- the weight of target ``i`` is the byte distance to the next record's
+  offset; the final record, whose extent the offset list cannot see,
+  weighs the mean record size. Weights are derived only from the
+  PUBLISHED offsets, so any worker recomputing bounds from meta.json
+  gets the same answer — no new shared state;
+- merged output is unaffected: bounds change which worker polishes a
+  target, never the target order the merge emits.
+
+``RACON_TPU_AVA_WEIGHTED=0`` falls back to the count partition (the
+A/B lever scripts/ava_scale_smoke.py uses to show the skew).
+"""
+
+from __future__ import annotations
+
+from bisect import bisect_left
+from typing import List, Optional, Sequence
+
+from racon_tpu.utils import envspec
+
+ENV_AVA_WEIGHTED = "RACON_TPU_AVA_WEIGHTED"
+
+
+def weighted_enabled() -> bool:
+    return envspec.read(ENV_AVA_WEIGHTED).strip().lower() not in (
+        "0", "false", "no", "off")
+
+
+def weights_from_offsets(offsets: Sequence[int]) -> List[int]:
+    """Per-target byte weights from record start offsets. Each target
+    weighs the gap to its successor's offset (header + data + quality
+    bytes — exactly the I/O and, for length-proportional consensus
+    work, the compute it represents); the last target weighs the mean
+    gap, the best estimate the offset list alone supports. Every
+    weight is at least 1 so empty-looking records still count."""
+    n = len(offsets)
+    if n == 0:
+        return []
+    if n == 1:
+        return [1]
+    weights = [max(1, int(offsets[i + 1]) - int(offsets[i]))
+               for i in range(n - 1)]
+    weights.append(max(1, round(sum(weights) / len(weights))))
+    return weights
+
+
+def weighted_partition(n_targets: int, n_shards: int,
+                       weights: Sequence[int]) -> List[int]:
+    """Contiguous bounds cutting ``weights`` into ``n_shards`` runs of
+    near-equal total weight: shard ``k`` owns ``[bounds[k],
+    bounds[k+1])``. Cut ``k`` lands where the weight prefix first
+    reaches ``k/n_shards`` of the total, then is clamped so every
+    shard (including all that follow) keeps at least one target —
+    the non-empty-shard invariant the count partition guarantees."""
+    if len(weights) != n_targets:
+        raise ValueError(
+            f"[racon_tpu::ava] weighted_partition got {len(weights)} "
+            f"weights for {n_targets} targets")
+    prefix = [0]
+    for w in weights:
+        prefix.append(prefix[-1] + max(1, int(w)))
+    total = prefix[-1]
+    bounds = [0]
+    for k in range(1, n_shards):
+        ideal = total * k / n_shards
+        cut = bisect_left(prefix, ideal)
+        # Keep >=1 target in this shard and >=1 in each remaining one.
+        cut = max(cut, bounds[-1] + 1)
+        cut = min(cut, n_targets - (n_shards - k))
+        bounds.append(cut)
+    bounds.append(n_targets)
+    return bounds
+
+
+def weighted_bounds(n_targets: int, n_shards: int,
+                    offsets: Sequence[int]) -> Optional[List[int]]:
+    """The bounds ``WorkLedger.open`` publishes when per-target offsets
+    are in hand: the length-weighted partition, or ``None`` to keep
+    the count partition (gate off, offset list inconsistent with the
+    target count, or a single shard where balance is moot)."""
+    if n_shards <= 1 or len(offsets) != n_targets:
+        return None
+    if not weighted_enabled():
+        return None
+    return weighted_partition(n_targets, n_shards,
+                              weights_from_offsets(offsets))

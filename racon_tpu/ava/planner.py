@@ -1,0 +1,114 @@
+"""Greedy run-level shape buckets for read-length diversity.
+
+The shape-bucket scheduler (racon_tpu/sched/) already coalesces window
+shapes inside one polisher; what it cannot control is how many DISTINCT
+overlap-alignment geometries an ava run presents to the device in the
+first place — one per distinct padded read length, and an assembly-scale
+read set has millions of distinct lengths. Every distinct geometry is a
+compile (PROFILE.md: 44.5 s cold), so unplanned ava input is a compile
+storm.
+
+The planner quantizes lengths to a bucket quantum
+(``ops/budget.ava_bucket_quantum``, tied to the consensus window
+length), sweeps the targets IN INPUT ORDER coalescing consecutive
+same-bucket reads into runs (reads arrive roughly length-sorted from
+many assemblers, so run-level greediness preserves that locality for
+the ledger's contiguous shards), and layers the result over the PR 6
+tile tiers: each bucket's compile key is its padded length plus the
+tier geometry ``ops/budget.tile_plan`` would pick for a same-length
+overlap. If the distinct buckets exceed the compile budget
+(``RACON_TPU_AVA_COMPILE_BUDGET``), the quantum doubles and the sweep
+repeats — coarser buckets mean more padding, never more compiles, so
+the loop always terminates with ``n_buckets <= budget``.
+
+The plan is published (``ava_*`` gauges, docs/OBSERVABILITY.md) by the
+distributed worker at ledger-join time, costing one pass over the
+already-published offset deltas — no extra file I/O.
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional, Sequence, Tuple
+
+from racon_tpu.ops import budget as _budget
+
+
+class BucketPlan(NamedTuple):
+    """One planned run: ``buckets`` maps padded-length capacity to read
+    count (ascending by capacity); ``n_runs`` counts the input-order
+    runs the greedy sweep coalesced (locality measure: n_runs close to
+    n_buckets means the input was already length-sorted);
+    ``compile_keys`` are the distinct (tier W, tier T, capacity)
+    geometry classes — the compile count the budget bounds;
+    ``pad_frac`` is the padding overhead the quantization costs."""
+    n_targets: int
+    quantum: int
+    buckets: Tuple[Tuple[int, int], ...]
+    n_runs: int
+    compile_keys: Tuple[Tuple[int, int, int], ...]
+    pad_frac: float
+    budget: int
+
+    @property
+    def n_buckets(self) -> int:
+        return len(self.buckets)
+
+
+def _tier_key(cap: int) -> Tuple[int, int]:
+    """The tile-tier geometry a same-length overlap of ``cap`` bases
+    lands on — (W, T) of the admitting tier, or (0, 0) for the
+    untiled/native class. Equal-length pairs always clear the band-
+    clearance test, so this is a pure function of the capacity."""
+    plan = _budget.tile_plan(cap, cap)
+    if plan is None:
+        return (0, 0)
+    return (plan.W, plan.T)
+
+
+def plan_buckets(lengths: Sequence[int], *, window_length: int = 500,
+                 budget: Optional[int] = None) -> BucketPlan:
+    """Plan shape buckets for ``lengths`` (per-target sizes, input
+    order). Guarantees ``n_buckets <= budget`` by doubling the quantum;
+    raises on an empty target set (the ledger refuses those runs before
+    planning ever happens)."""
+    if not lengths:
+        raise ValueError(
+            "[racon_tpu::ava] plan_buckets needs at least one target")
+    if budget is None:
+        budget = _budget.ava_compile_budget()
+    budget = max(1, int(budget))
+    quantum = _budget.ava_bucket_quantum(window_length)
+    total_len = sum(max(1, int(ln)) for ln in lengths)
+    while True:
+        counts = {}
+        n_runs = 0
+        prev_cap = None
+        padded_total = 0
+        for ln in lengths:
+            ln = max(1, int(ln))
+            cap = -(-ln // quantum) * quantum
+            padded_total += cap
+            counts[cap] = counts.get(cap, 0) + 1
+            if cap != prev_cap:
+                n_runs += 1
+                prev_cap = cap
+        if len(counts) <= budget:
+            break
+        quantum *= 2
+    buckets = tuple(sorted(counts.items()))
+    keys = tuple(sorted({_tier_key(cap) + (cap,) for cap, _ in buckets}))
+    pad_frac = round(1.0 - total_len / padded_total, 4) \
+        if padded_total else 0.0
+    return BucketPlan(n_targets=len(lengths), quantum=quantum,
+                      buckets=buckets, n_runs=n_runs,
+                      compile_keys=keys, pad_frac=pad_frac,
+                      budget=budget)
+
+
+def lengths_from_offsets(offsets: Sequence[int]) -> List[int]:
+    """Per-target byte sizes from the ledger's published record
+    offsets — the planner's input when no parse has happened yet. Byte
+    extents overstate base counts by the header/quality overhead, but
+    bucketing is scale-free so the bucket structure is the same."""
+    from racon_tpu.ava.partition import weights_from_offsets
+    return weights_from_offsets(offsets)

@@ -315,9 +315,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                                                      run_fingerprint)
         try:
             fp = run_fingerprint(ckpt_config, args.paths[:3])
+            from racon_tpu.ava import seg_targets_for
             store = (CheckpointStore.resume(args.checkpoint_dir, fp)
                      if args.resume else
-                     CheckpointStore.create(args.checkpoint_dir, fp))
+                     CheckpointStore.create(
+                         args.checkpoint_dir, fp,
+                         segment_targets=seg_targets_for(
+                             args.fragment_correction)))
         except (CheckpointError, OSError) as exc:
             print(str(exc), file=sys.stderr)
             return 1
@@ -394,6 +398,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                     workers=args.workers, lease_s=args.lease_s,
                     make_polisher=make_polisher,
                     drop_unpolished=not args.include_unpolished,
+                    fragment_correction=args.fragment_correction,
+                    window_length=args.window_length,
                     out=out)
             else:
                 # The serial frontend is now a thin call into the

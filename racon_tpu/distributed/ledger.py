@@ -226,8 +226,10 @@ class WorkLedger:
         self.n_targets: int = int(meta["n_targets"])
         self.lease_s: float = float(meta["lease_s"])
         # Optional per-target byte offsets into the target file (from
-        # io.parsers.scan_sequence_index, published by the winner) —
-        # observability plus a future seek-to-shard ingest hook.
+        # io.parsers.scan_sequence_index, published by the winner).
+        # They drive the weighted partition above, feed the ava shape
+        # planner (every worker derives per-target lengths from them
+        # without re-scanning), and remain the seek-to-shard hook.
         off = meta.get("target_offsets")
         self.target_offsets: Optional[List[int]] = \
             None if off is None else [int(o) for o in off]
@@ -237,7 +239,7 @@ class WorkLedger:
     def open(cls, directory: str, fingerprint: str, *,
              n_targets: Optional[int] = None, workers: int = 1,
              lease_s: float = 30.0, n_shards: Optional[int] = None,
-             scan_targets=None) -> "WorkLedger":
+             scan_targets=None, weighted: bool = False) -> "WorkLedger":
         """Open (publishing if first) the ledger for this run.
 
         Every worker calls this with its own view of the run identity;
@@ -283,11 +285,26 @@ class WorkLedger:
                     n_shards = max(1, int(workers) * 2)
             n_shards = max(1, min(int(n_shards), n_targets))
             os.makedirs(directory, exist_ok=True)
+            bounds = _partition(n_targets, n_shards)
+            if weighted and offsets is not None:
+                # Length-weighted bounds for read-scale target sets:
+                # the ava regime's targets span orders of magnitude in
+                # size, so equal-count shards can differ 10x in work.
+                # Opt-in per open (the kF worker passes weighted=True)
+                # so contig-polish runs keep the count partition their
+                # fault-index drills are written against. Only the
+                # publishing worker computes this (from the offsets it
+                # just scanned); joiners adopt the published bounds
+                # like any other partition (docs/AVA.md).
+                from racon_tpu.ava.partition import weighted_bounds
+                wb = weighted_bounds(n_targets, n_shards, offsets)
+                if wb is not None:
+                    bounds = wb
             meta = {
                 "schema": SCHEMA,
                 "fingerprint": fingerprint,
                 "n_targets": int(n_targets),
-                "bounds": _partition(n_targets, n_shards),
+                "bounds": bounds,
                 "lease_s": float(lease_s),
                 "workers": int(workers),
             }

@@ -136,12 +136,17 @@ def _maybe_split(ledger: WorkLedger, claim: Claim, next_tid: int,
     return True
 
 
-def _open_store(ledger: WorkLedger, shard) -> ckpt.CheckpointStore:
+def _open_store(ledger: WorkLedger, shard,
+                seg_targets: int = 0) -> ckpt.CheckpointStore:
     d = ledger.shard_ckpt_dir(shard)
     fp = ledger.shard_fp(shard)
     if os.path.exists(os.path.join(d, ckpt.META_NAME)):
+        # Resume reads the manifest flavor from its own header — the
+        # seg_targets this worker was launched with never rewrites an
+        # existing store's mode.
         return ckpt.CheckpointStore.resume(d, fp)
-    return ckpt.CheckpointStore.create(d, fp)
+    return ckpt.CheckpointStore.create(d, fp,
+                                       segment_targets=seg_targets)
 
 
 def _shard_cache():
@@ -167,7 +172,7 @@ def _shard_cache():
 
 def _polish_shard(ledger: WorkLedger, claim: Claim,
                   make_polisher: Callable, drop_unpolished: bool, log,
-                  t_shard: float) -> int:
+                  t_shard: float, seg_targets: int = 0) -> int:
     """Polish one claimed shard to completion; returns the number of
     committed targets in the shard's final effective range. Raises
     LeaseLost the moment the lease is observed stolen.
@@ -181,7 +186,7 @@ def _polish_shard(ledger: WorkLedger, claim: Claim,
     range end).
     """
     info = claim.info
-    store = _open_store(ledger, info)
+    store = _open_store(ledger, info, seg_targets)
     cache = _shard_cache()
     try:
         start = info.start
@@ -308,6 +313,9 @@ def run_worker(*, ledger_dir: str, fingerprint: str,
                worker_id: Optional[str], workers: int, lease_s: float,
                make_polisher: Callable, drop_unpolished: bool,
                n_targets: Optional[int] = None, scan_targets=None,
+               fragment_correction: bool = False,
+               seg_targets: Optional[int] = None,
+               window_length: int = 500,
                out=None, log=None) -> int:
     """Drive one worker from fleet join to merged output.
 
@@ -328,19 +336,45 @@ def run_worker(*, ledger_dir: str, fingerprint: str,
     ``io/read`` / ``io/inflate``) exercise exactly the production
     reader. The gauge below puts the gate state in every fleet metric
     shard.
+
+    Ava (docs/AVA.md): ``fragment_correction`` selects the v2
+    segmented checkpoint manifest for fresh shard stores
+    (``seg_targets`` overrides the ``ava.seg_targets_for`` default)
+    and, when the ledger published per-target offsets, runs the shape
+    planner once at join time — publishing the run's bucket plan
+    against the compile budget before any shard is claimed.
     """
     out = out if out is not None else sys.stdout.buffer
     log = log if log is not None else sys.stderr
     worker = worker_id or default_worker_id()
     ledger = WorkLedger.open(ledger_dir, fingerprint,
                              n_targets=n_targets, workers=workers,
-                             lease_s=lease_s, scan_targets=scan_targets)
+                             lease_s=lease_s, scan_targets=scan_targets,
+                             weighted=bool(fragment_correction))
     from racon_tpu.io.ingest import ingest_enabled
     from racon_tpu.obs.metrics import registry as _registry
     _registry().set("ingest_enabled", int(ingest_enabled()))
     set_dist("workers", int(workers))
     set_dist("shards", ledger.n_shards)
     set_dist("n_targets", ledger.n_targets)
+    from racon_tpu.ava import seg_targets_for
+    if seg_targets is None:
+        seg_targets = seg_targets_for(fragment_correction)
+    if fragment_correction and ledger.target_offsets:
+        # Shape-bucket plan for the whole run, from the published
+        # offsets (no file I/O): every worker computes the identical
+        # plan, so the published gauges agree fleet-wide.
+        from racon_tpu.ava.planner import lengths_from_offsets, \
+            plan_buckets
+        from racon_tpu.obs.metrics import record_ava_plan
+        plan = plan_buckets(lengths_from_offsets(ledger.target_offsets),
+                            window_length=window_length)
+        record_ava_plan(plan)
+        print(f"[racon_tpu::ava] worker: {plan.n_targets} target(s) "
+              f"in {plan.n_buckets} shape bucket(s) "
+              f"(quantum {plan.quantum}, "
+              f"{len(plan.compile_keys)} compile key(s) vs budget "
+              f"{plan.budget}, pad {plan.pad_frac:.2%})", file=log)
     # Fleet observability plane (racon_tpu/obs/fleet.py): publish this
     # worker's metric shard at join time, tag every span with the
     # worker identity, and keep the shard fresh per contig. The CLI's
@@ -380,7 +414,7 @@ def run_worker(*, ledger_dir: str, fingerprint: str,
             try:
                 n = _polish_shard(ledger, claim, make_polisher,
                                   drop_unpolished, log,
-                                  time.monotonic())
+                                  time.monotonic(), seg_targets)
                 ledger.complete(claim, n_committed=n)
             except LeaseLost:
                 # The shard was stolen while we held it (our own lease

@@ -18,6 +18,13 @@ The decision is pure policy over two numbers:
 routes even small jobs out — the lone daemon is the bottleneck, not
 the job). ``gate/route`` is the decision's fault site.
 
+Ava jobs (``fragment_correction``, docs/AVA.md) size by **total
+target bytes** (``RACON_TPU_GATE_FLEET_MIN_BYTES``) instead of target
+count: every read is a target there, so a count threshold tuned for
+contigs would ship trivially small correction jobs to the fleet while
+a megabase read set with few records stayed local. The queue-pressure
+override applies to both regimes.
+
 A fleet run reuses the distributed plane wholesale: the run directory
 is keyed by the job **fingerprint** (the run identity the ledger and
 the CAS already share), so a resubmitted or crash-adopted job attaches
@@ -49,6 +56,7 @@ from racon_tpu.utils import envspec
 
 ENV_GATE_FLEET = "RACON_TPU_GATE_FLEET"
 ENV_MIN_TARGETS = "RACON_TPU_GATE_FLEET_MIN_TARGETS"
+ENV_MIN_BYTES = "RACON_TPU_GATE_FLEET_MIN_BYTES"
 ENV_QUEUE_PRESSURE = "RACON_TPU_GATE_QUEUE_PRESSURE"
 ENV_GATE_WORKERS = "RACON_TPU_GATE_WORKERS"
 
@@ -68,6 +76,7 @@ class RouteDecision(NamedTuple):
     reason: str         # human-readable policy clause that fired
     n_targets: int
     queue_depth: int
+    target_bytes: int = 0  # ava size signal (0 for count-routed jobs)
 
 
 class FleetPaths(NamedTuple):
@@ -91,29 +100,53 @@ def count_targets(targets_path: str) -> int:
     return n_records
 
 
-def decide_route(spec, n_targets: int,
-                 queue_depth: int = 0) -> RouteDecision:
+def target_stats(targets_path: str) -> "tuple":
+    """(target count, targets-file byte size) — the two routing size
+    signals. The byte size is a stat, not a scan; it overstates
+    sequence bytes by header/quality overhead, which is fine for a
+    routing threshold."""
+    return count_targets(targets_path), os.path.getsize(targets_path)
+
+
+def decide_route(spec, n_targets: int, queue_depth: int = 0,
+                 target_bytes: int = 0) -> RouteDecision:
     """Pure routing policy (the test matrix drives this directly).
     Fleet when armed AND (the job is large enough, or the daemon's
     queue is deep enough that shipping even a small job out beats
-    waiting). ``gate/route`` fires before the decision is read."""
+    waiting). Fragment-correction jobs measure "large enough" in
+    target BYTES, everything else in target count. ``gate/route``
+    fires before the decision is read."""
     maybe_fault("gate/route")
+    ava = bool(getattr(spec, "fragment_correction", False))
     if not fleet_enabled():
         return RouteDecision("local", "fleet-disabled", n_targets,
-                             queue_depth)
-    min_targets = max(1, int(envspec.read(ENV_MIN_TARGETS)))
+                             queue_depth, target_bytes)
     pressure = max(1, int(envspec.read(ENV_QUEUE_PRESSURE)))
+    if ava:
+        min_bytes = max(1, int(envspec.read(ENV_MIN_BYTES)))
+        if target_bytes >= min_bytes:
+            return RouteDecision(
+                "fleet", f"target_bytes {target_bytes} >= {min_bytes}",
+                n_targets, queue_depth, target_bytes)
+        if queue_depth >= pressure:
+            return RouteDecision(
+                "fleet", f"queue_depth {queue_depth} >= {pressure}",
+                n_targets, queue_depth, target_bytes)
+        return RouteDecision(
+            "local", f"target_bytes {target_bytes} < {min_bytes}",
+            n_targets, queue_depth, target_bytes)
+    min_targets = max(1, int(envspec.read(ENV_MIN_TARGETS)))
     if n_targets >= min_targets:
         return RouteDecision(
             "fleet", f"n_targets {n_targets} >= {min_targets}",
-            n_targets, queue_depth)
+            n_targets, queue_depth, target_bytes)
     if queue_depth >= pressure:
         return RouteDecision(
             "fleet", f"queue_depth {queue_depth} >= {pressure}",
-            n_targets, queue_depth)
+            n_targets, queue_depth, target_bytes)
     return RouteDecision(
         "local", f"n_targets {n_targets} < {min_targets}", n_targets,
-        queue_depth)
+        queue_depth, target_bytes)
 
 
 def fleet_paths(state_dir: str, fingerprint: str) -> FleetPaths:
@@ -237,16 +270,18 @@ def run_fleet_job(job, state_dir: str, store, *,
         raise FleetDispatchError(
             f"[racon_tpu::gate] fleet run for job {job.id} finished "
             f"without a merged output at {out_path}")
-    with open(out_path, "rb") as fh:
-        blob = fh.read()
     # Re-commit the merged result through the job's own store in the
     # same emit-then-commit order polish_job uses: /stream, restart
     # recovery, and the daemon CAS see a fleet job exactly like a
     # local one. serve/commit keeps its meaning — "one contig became
-    # durable in this job's store" — whichever path computed it.
+    # durable in this job's store" — whichever path computed it. The
+    # records stream straight off the merged file (ava runs emit one
+    # per read — the whole-blob split this replaces held two copies
+    # of a potentially enormous output in memory at once).
+    from racon_tpu.ava.emit import iter_fasta_records
     n = 0
     committed = len(store.committed)
-    for tid, rec in enumerate(_split_fasta(blob)):
+    for tid, rec in enumerate(iter_fasta_records(out_path)):
         if tid < committed:
             # Adoption/restart: the committed prefix re-emits from the
             # store byte-for-byte (polish_job's emit_stored contract),

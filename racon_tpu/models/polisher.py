@@ -14,6 +14,7 @@ thread pool:
 from __future__ import annotations
 
 import enum
+import os
 import sys
 from typing import Dict, List, Optional, Tuple
 
@@ -123,18 +124,36 @@ class Polisher:
         from racon_tpu.io.ingest import prefetch_ok
         from racon_tpu.pipeline.streaming import (IngestPrefetcher,
                                                   serial_chunks)
+        # kF single-parse (docs/AVA.md): a fragment-correction
+        # invocation passes the SAME file as reads and targets
+        # (``racon reads paf reads -f``), so parsing it twice doubles
+        # the dominant I/O of an assembly-scale run for records the
+        # dedup phase immediately discards. When the two paths are one
+        # file, phase 2 feeds from the already-loaded targets instead
+        # of a second parse — every lookup, comparison, and counter
+        # runs as before, so the result is byte-identical.
+        s_path = getattr(self.sparser, "path", None)
+        t_path = getattr(self.tparser, "path", None)
+        shared = (s_path is not None and t_path is not None
+                  and os.path.realpath(s_path)
+                  == os.path.realpath(t_path))
         prefetchers: List[IngestPrefetcher] = []
+        src_s = None
         if prefetch_ok():
             pf_t = IngestPrefetcher(self.tparser, CHUNK_SIZE, "targets")
-            pf_s = IngestPrefetcher(self.sparser, CHUNK_SIZE, "reads")
             pf_o = IngestPrefetcher(self.oparser, CHUNK_SIZE, "overlaps")
-            prefetchers = [pf_t, pf_s, pf_o]
+            prefetchers = [pf_t, pf_o]
+            if not shared:
+                pf_s = IngestPrefetcher(self.sparser, CHUNK_SIZE,
+                                        "reads")
+                prefetchers.append(pf_s)
+                src_s = pf_s.chunks()
             src_t = pf_t.chunks()
-            src_s = pf_s.chunks()
             src_o = pf_o.chunks()
         else:
             src_t = serial_chunks(self.tparser, CHUNK_SIZE)
-            src_s = serial_chunks(self.sparser, CHUNK_SIZE)
+            if not shared:
+                src_s = serial_chunks(self.sparser, CHUNK_SIZE)
             src_o = serial_chunks(self.oparser, CHUNK_SIZE)
         try:
             self._load_inputs(src_t, src_s, src_o, log)
@@ -144,7 +163,10 @@ class Polisher:
 
     def _load_inputs(self, src_t, src_s, src_o, log) -> None:
         """Phases 1-7 of initialize(), consuming the three ingest chunk
-        streams (prefetched or serial — same protocol)."""
+        streams (prefetched or serial — same protocol). ``src_s`` may
+        be None — the reads ARE the targets (kF single-parse above) —
+        and phase 2 then replays the loaded target records through the
+        identical dedup/bookkeeping path without touching the file."""
         # 1. Targets (src/polisher.cpp:172-187).
         self.sequences = []
         for chunk, _more in src_t:
@@ -171,6 +193,12 @@ class Polisher:
 
         # 2. Reads, streamed and deduplicated against targets
         # (src/polisher.cpp:196-234).
+        if src_s is None:
+            # The slice is a copy, so the loop below never iterates a
+            # list it is appending to (it won't append here — every
+            # "read" dedups against itself — but the invariant should
+            # not depend on that).
+            src_s = [(self.sequences[:targets_size], False)]
         sequences_size = 0
         total_len = 0
         for chunk, _more in src_s:

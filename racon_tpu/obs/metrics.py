@@ -868,6 +868,52 @@ def gate_extras(reg: Optional[MetricsRegistry] = None
     return out
 
 
+# ------------------------------------------------------------ ava plane
+
+
+def record_ava_plan(plan,
+                    reg: Optional[MetricsRegistry] = None) -> None:
+    """Publish the ava shape-bucket plan (racon_tpu/ava/planner.py,
+    docs/AVA.md): target count, bucket count vs the compile budget,
+    the quantum the budget loop settled on, and the padding overhead
+    it cost. All gauges — every worker computes the identical plan
+    from the published offsets, so the fleet merge takes the last."""
+    reg = reg if reg is not None else _REGISTRY
+    reg.set("ava_targets", int(plan.n_targets))
+    reg.set("ava_buckets", int(plan.n_buckets))
+    reg.set("ava_quantum", int(plan.quantum))
+    reg.set("ava_compile_budget", int(plan.budget))
+    reg.set("ava_pad_frac", round(float(plan.pad_frac), 4))
+
+
+def set_ava_bench(reads_per_sec: float, peak_rss_mb: float,
+                  manifest_bytes_per_target: float,
+                  reg: Optional[MetricsRegistry] = None) -> None:
+    """Set the ava bench gauges (bench metric_version 17): corrected
+    reads per wall second, the run's peak resident set, and manifest
+    bytes per committed target — the v2 segmented manifest's
+    amortization, which v1's one-record-per-target format holds at
+    ~100 regardless of scale."""
+    reg = reg if reg is not None else _REGISTRY
+    reg.set("ava_reads_per_sec", round(float(reads_per_sec), 4))
+    reg.set("ava_peak_rss_mb", round(float(peak_rss_mb), 4))
+    reg.set("ava_manifest_bytes_per_target",
+            round(float(manifest_bytes_per_target), 4))
+
+
+def ava_extras(reg: Optional[MetricsRegistry] = None
+               ) -> Dict[str, object]:
+    """The registry's ava_* keys as a JSON-ready dict (bench extras
+    metric_version 17 / obs_report "ava:" section). Empty when no ava
+    planning ran, so kC runs stay quiet."""
+    reg = reg if reg is not None else _REGISTRY
+    out: Dict[str, object] = {}
+    for k, v in sorted(reg.snapshot().items()):
+        if k.startswith("ava_"):
+            out[k] = round(v, 4) if isinstance(v, float) else v
+    return out
+
+
 # --------------------------------------------------- result cache plane
 
 
@@ -1025,6 +1071,14 @@ _MERGE_LAST_KEYS = frozenset({
     # the gate_* routed/adoption/run counters sum.
     "gate_fleet_target", "gate_fleet_jobs_per_min",
     "gate_compile_skip_s",
+    # Ava plane gauges (record_ava_plan / set_ava_bench above): the
+    # bucket plan is identical on every worker and the bench readings
+    # are per-run measurements, so the latest snapshot wins —
+    # ava_peak_rss_mb is listed despite its name because it lacks the
+    # ``_peak`` suffix the max rule keys on.
+    "ava_targets", "ava_buckets", "ava_quantum", "ava_compile_budget",
+    "ava_pad_frac", "ava_reads_per_sec", "ava_peak_rss_mb",
+    "ava_manifest_bytes_per_target",
 })
 
 
@@ -1058,6 +1112,15 @@ METRIC_SPECS = (
     ("adaptive_rounds_executed", MERGE_SUM, "adaptive_rounds_executed"),
     ("adaptive_rounds_scheduled", MERGE_SUM, "adaptive_rounds_scheduled"),
     ("align_phase_seconds", MERGE_SUM, "align_phase_seconds"),
+    ("ava_buckets", MERGE_LAST, "ava_buckets"),
+    ("ava_compile_budget", MERGE_LAST, "ava_compile_budget"),
+    ("ava_manifest_bytes_per_target", MERGE_LAST,
+     "ava_manifest_bytes_per_target"),
+    ("ava_pad_frac", MERGE_LAST, "ava_pad_frac"),
+    ("ava_peak_rss_mb", MERGE_LAST, "ava_peak_rss_mb"),
+    ("ava_quantum", MERGE_LAST, "ava_quantum"),
+    ("ava_reads_per_sec", MERGE_LAST, "ava_reads_per_sec"),
+    ("ava_targets", MERGE_LAST, "ava_targets"),
     ("cache_bytes", MERGE_SUM, "cache_bytes"),
     ("cache_evictions_total", MERGE_SUM, "cache_evictions_total"),
     ("cache_hit_ratio", MERGE_LAST, "cache_hit_ratio"),

@@ -283,6 +283,53 @@ def tile_plan(lq: int, lt: int, tiers=None):
 
 
 # ---------------------------------------------------------------------------
+# Ava shape-bucket budget (racon_tpu/ava/planner.py, docs/AVA.md).
+#
+# In the all-vs-all regime every read is a target AND a query, so the
+# device sees as many distinct overlap geometries as the run has
+# distinct read lengths — millions, where kC polishing sees dozens.
+# Each distinct padded geometry is a compile. The planner absorbs the
+# diversity by quantizing lengths to a bucket quantum and coarsening
+# (doubling the quantum) until the distinct-bucket count fits the
+# compile budget below; the quantum ties to the consensus window
+# length so bucketing never out-resolves the window granularity the
+# engine already pads to.
+# ---------------------------------------------------------------------------
+
+ENV_AVA_COMPILE_BUDGET = "RACON_TPU_AVA_COMPILE_BUDGET"
+_AVA_COMPILE_BUDGET_DEFAULT = 8
+
+
+def ava_compile_budget() -> int:
+    """Max distinct shape buckets (== compile keys) the ava planner may
+    plan (``RACON_TPU_AVA_COMPILE_BUDGET``, default 8). Invalid or
+    non-positive values are a hard error — a typo silently exploding
+    compiles is exactly what the budget exists to prevent."""
+    raw = envspec.read(ENV_AVA_COMPILE_BUDGET).strip()
+    if not raw:
+        return _AVA_COMPILE_BUDGET_DEFAULT
+    try:
+        n = int(raw)
+    except ValueError:
+        n = -1
+    if n < 1:
+        raise ValueError(
+            f"[racon_tpu::budget] {ENV_AVA_COMPILE_BUDGET}={raw!r} "
+            "invalid — expected a positive bucket count")
+    return n
+
+
+def ava_bucket_quantum(window_length: int) -> int:
+    """Starting length-bucket granularity for the ava planner: a power
+    of two near ``window_length / 8`` (64 for the default 500-base
+    window), floored at 16. Finer than this out-resolves the engine's
+    own window padding; the planner doubles it as needed to meet the
+    compile budget."""
+    w = max(1, int(window_length))
+    return 1 << max(4, (w // 8).bit_length())
+
+
+# ---------------------------------------------------------------------------
 # Watchdog deadline derivation (fail-slow detection, resilience/watchdog.py).
 #
 # A deadline must be generous enough that legitimate work — a cold

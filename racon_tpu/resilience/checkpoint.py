@@ -25,6 +25,27 @@ run. The store keeps three files in ``--checkpoint-dir``:
     or ``{"ev": "contig", "tid": N, "emitted": false}`` for targets the
     run dropped (--drop-unpolished semantics must survive resume too).
 
+**Segmented manifests (v2).** An ava run (docs/AVA.md) commits
+millions of read-sized targets; one fsync'd manifest record per target
+is exactly the cost that cannot survive that scale. A store created
+with ``segment_targets > 0`` writes a v2 manifest: the header gains
+``"manifest": 2, "seg_targets": N`` and commits amortize into
+run-length **segment** records —
+``{"ev": "seg", "start": A, "end": B, "offset": O, "lengths": [...]}``
+covering targets ``[A, B)`` whose blobs sit contiguously at shard
+offset ``O`` (a zero length marks a dropped target; emitted blobs are
+never shorter than 3 bytes, so zero is unambiguous). Commits buffer:
+each shard write is flushed (``read_emitted`` still slices live bytes)
+but the fsync-pair — shard fsync, then one manifest append — happens
+once per **seal** (buffer full, a target-id discontinuity, or close).
+Every ``RACON_TPU_AVA_COMPACT`` seals the manifest is compacted:
+adjacent contiguous segments merge and the file is atomically
+rewritten, so manifest size is O(segments), not O(targets). The torn
+recovery contract is unchanged — the longest valid manifest prefix
+wins, a crash forfeits at most the one unsealed segment (recomputed on
+resume), and v2 code resumes v1 stores as before (``resume`` takes the
+mode from the manifest header, not from the caller).
+
 Crash consistency is ordering, not locking: the shard append is fsync'd
 **before** its manifest record is appended (also fsync'd), so a
 manifest record always points at durable shard bytes. The first append
@@ -58,13 +79,31 @@ import json
 import os
 from typing import Dict, IO, Iterable, Optional
 
+from racon_tpu.utils import envspec
 from racon_tpu.utils.atomicio import (append_fsync, atomic_write_text,
                                       fsync_dir, load_jsonl_prefix)
 
 SCHEMA = 1
+MANIFEST_V2 = 2
 META_NAME = "meta.json"
 SHARD_NAME = "contigs.fasta"
 MANIFEST_NAME = "manifest.jsonl"
+
+ENV_AVA_COMPACT = "RACON_TPU_AVA_COMPACT"
+DEFAULT_COMPACT_EVERY = 64
+
+
+def compact_every() -> int:
+    """Sealed segments between v2 manifest compaction rewrites
+    (``0`` disables compaction; malformed values disable it too —
+    compaction is an optimization, never a correctness lever)."""
+    raw = envspec.read(ENV_AVA_COMPACT).strip()
+    if not raw:
+        return DEFAULT_COMPACT_EVERY
+    try:
+        return max(0, int(raw))
+    except ValueError:
+        return 0
 
 
 class CheckpointError(ValueError):
@@ -129,6 +168,20 @@ class CheckpointStore:
         # The first commit after open fsyncs the directory so the
         # shard/manifest *entries* are durable, not just their bytes.
         self._dir_synced = False
+        #: Targets per v2 manifest segment; 0 = v1 per-target records.
+        self.segment_targets = 0
+        # Open-segment state (v2): buffered (tid, blob_len) pairs —
+        # contiguous by construction (a discontinuity seals first) —
+        # the shard offset where the segment starts, and the shard end
+        # including flushed-but-unsealed bytes (the file handle's
+        # position is not consulted after open).
+        self._seg: list = []
+        self._seg_offset = 0
+        self._shard_pos = 0
+        # Sealed segment records since the last compaction rewrite.
+        self._seg_log: list = []
+        self._sealed_since_compact = 0
+        self._compact_every = 0
 
     # -------------------------------------------------- construction
     @property
@@ -144,11 +197,16 @@ class CheckpointStore:
         return os.path.join(self.directory, MANIFEST_NAME)
 
     @classmethod
-    def create(cls, directory: str,
-               fingerprint: str) -> "CheckpointStore":
-        """Start a fresh store, replacing any previous contents."""
+    def create(cls, directory: str, fingerprint: str, *,
+               segment_targets: int = 0) -> "CheckpointStore":
+        """Start a fresh store, replacing any previous contents.
+        ``segment_targets > 0`` selects the v2 segmented manifest
+        (``ava.seg_targets_for`` picks it for fragment-correction
+        runs); the mode is recorded in the manifest header, so resume
+        never needs to be told."""
         os.makedirs(directory, exist_ok=True)
         store = cls(directory, fingerprint)
+        store.segment_targets = max(0, int(segment_targets))
         for path in (store.shard_path, store.manifest_path):
             if os.path.exists(path):
                 os.remove(path)
@@ -159,6 +217,10 @@ class CheckpointStore:
         store._manifest = open(store.manifest_path, "ab")
         header = {"ev": "begin", "schema": SCHEMA,
                   "fingerprint": fingerprint}
+        if store.segment_targets:
+            header["manifest"] = MANIFEST_V2
+            header["seg_targets"] = store.segment_targets
+            store._compact_every = compact_every()
         append_fsync(store._manifest, (json.dumps(
             header, sort_keys=True) + "\n").encode(),
             sync_dir=directory)
@@ -203,6 +265,14 @@ class CheckpointStore:
                          int(rec["length"]), rec["name"])
                 else:
                     _ = (int(rec["tid"]), rec["emitted"])
+            elif rec.get("ev") == "seg":
+                start, end = int(rec["start"]), int(rec["end"])
+                lengths = rec["lengths"]
+                if (not isinstance(lengths, list)
+                        or len(lengths) != end - start
+                        or end <= start):
+                    raise ValueError("malformed seg record")
+                _ = (int(rec["offset"]), [int(x) for x in lengths])
 
         try:
             records, clean = load_jsonl_prefix(self.manifest_path,
@@ -221,21 +291,37 @@ class CheckpointStore:
                 "[racon_tpu::checkpoint] refusing to resume: manifest "
                 "header fingerprint does not match this run")
 
+        if records[0].get("manifest") == MANIFEST_V2:
+            # The store's mode travels in its header, not in caller
+            # arguments — resume paths stay signature-compatible.
+            self.segment_targets = max(
+                1, int(records[0].get("seg_targets", 1)))
+            self._compact_every = compact_every()
+
         shard_size = os.path.getsize(self.shard_path) \
             if os.path.exists(self.shard_path) else 0
         shard_end = 0
         valid = [records[0]]
         for rec in records[1:]:
-            if rec.get("ev") != "contig":
-                continue
-            if "offset" in rec:
-                end = int(rec["offset"]) + int(rec["length"])
+            ev = rec.get("ev")
+            if ev == "contig":
+                if "offset" in rec:
+                    end = int(rec["offset"]) + int(rec["length"])
+                    if end > shard_size:
+                        # Manifest record without its shard bytes: only
+                        # possible with external tampering (the write
+                        # order forbids it) — stop trusting from here
+                        # on.
+                        break
+                    shard_end = max(shard_end, end)
+            elif ev == "seg":
+                end = int(rec["offset"]) + sum(
+                    int(x) for x in rec["lengths"])
                 if end > shard_size:
-                    # Manifest record without its shard bytes: only
-                    # possible with external tampering (the write order
-                    # forbids it) — stop trusting from here on.
                     break
                 shard_end = max(shard_end, end)
+            else:
+                continue
             valid.append(rec)
 
         if torn or len(valid) != len(records):
@@ -245,7 +331,8 @@ class CheckpointStore:
             atomic_write_bytes(self.manifest_path, data)
         if shard_size > shard_end:
             # Orphaned tail from a crash between shard append and
-            # manifest append: discard, that contig recomputes.
+            # manifest append (v1) or an unsealed segment's flushed
+            # blobs (v2): discard, those targets recompute.
             with open(self.shard_path, "r+b") as fh:
                 fh.truncate(shard_end)
                 fh.flush()
@@ -253,13 +340,35 @@ class CheckpointStore:
             fsync_dir(self.directory)
 
         for rec in valid[1:]:
-            self.committed[int(rec["tid"])] = rec
+            if rec.get("ev") == "seg":
+                # Expand the run-length segment into the same
+                # per-target records a v1 manifest would have held —
+                # nothing downstream (read_emitted, the CAS replay,
+                # the merge) knows which manifest flavor fed it.
+                off = int(rec["offset"])
+                for i, ln in enumerate(rec["lengths"]):
+                    tid = int(rec["start"]) + i
+                    ln = int(ln)
+                    if ln == 0:
+                        self.committed[tid] = {
+                            "ev": "contig", "tid": tid,
+                            "emitted": False}
+                    else:
+                        self.committed[tid] = {
+                            "ev": "contig", "tid": tid,
+                            "offset": off, "length": ln}
+                        off += ln
+                self._seg_log.append(rec)
+            else:
+                self.committed[int(rec["tid"])] = rec
 
         from racon_tpu.obs.metrics import record_ckpt
         record_ckpt("resume", len(self.committed), shard_end)
 
         self._shard = open(self.shard_path, "ab")
         self._manifest = open(self.manifest_path, "ab")
+        self._shard_pos = shard_end
+        self._seg_offset = shard_end
 
     # ---------------------------------------------------- operations
     def _append_manifest(self, rec: Dict) -> None:
@@ -278,14 +387,95 @@ class CheckpointStore:
         append_fsync(self._manifest, data, sync_dir=sync)
         self._dir_synced = True
 
+    def _buffer_commit(self, tid: int, off: int,
+                       blob_len: int) -> None:
+        """Add one committed target to the open v2 segment, sealing
+        first on a target-id discontinuity (segments are run-length
+        encodings — they must stay contiguous) and after when the
+        buffer reaches the segment size. ``off`` is where the target's
+        blob landed in the shard: a segment's offset is its FIRST
+        blob's offset, anchored here rather than at seal time because
+        a discontinuity seal runs after the new blob was already
+        written past the sealed segment's end."""
+        tid = int(tid)
+        if self._seg and tid != self._seg[-1][0] + 1:
+            self._seal_segment()
+        if not self._seg:
+            self._seg_offset = int(off)
+        self._seg.append((tid, blob_len))
+        if len(self._seg) >= self.segment_targets:
+            self._seal_segment()
+
+    def _seal_segment(self) -> None:
+        """Make the open segment durable: one shard fsync covering
+        every buffered blob, then one manifest append — the same
+        shard-before-manifest ordering as a v1 commit, amortized over
+        ``segment_targets`` targets. ``ckpt/manifest`` faults fire
+        here, so the torn-manifest drill lands exactly on a segment
+        boundary."""
+        if not self._seg:
+            return
+        from racon_tpu.obs.metrics import record_ckpt
+        self._shard.flush()
+        os.fsync(self._shard.fileno())
+        lengths = [ln for _, ln in self._seg]
+        rec = {"ev": "seg", "start": self._seg[0][0],
+               "end": self._seg[-1][0] + 1,
+               "offset": self._seg_offset, "lengths": lengths}
+        self._append_manifest(rec)
+        self._seg_log.append(rec)
+        self._seg = []
+        record_ckpt("seal", rec["start"], sum(lengths))
+        self._sealed_since_compact += 1
+        if (self._compact_every
+                and self._sealed_since_compact >= self._compact_every):
+            self._compact()
+
+    def _compact(self) -> None:
+        """Rewrite the v2 manifest with adjacent contiguous segments
+        merged — amortized O(segments) manifest size no matter how
+        long the run. The rewrite is atomic (write-temp + rename), so
+        a crash mid-compaction leaves the previous manifest intact;
+        byte-identity of recovery before and after is the compaction
+        test's contract."""
+        merged: list = []
+        for rec in self._seg_log:
+            prev = merged[-1] if merged else None
+            if (prev is not None
+                    and int(prev["end"]) == int(rec["start"])
+                    and int(prev["offset"])
+                    + sum(int(x) for x in prev["lengths"])
+                    == int(rec["offset"])):
+                prev["lengths"] = list(prev["lengths"]) \
+                    + list(rec["lengths"])
+                prev["end"] = rec["end"]
+            else:
+                merged.append(dict(rec))
+        header = {"ev": "begin", "schema": SCHEMA,
+                  "fingerprint": self.fingerprint,
+                  "manifest": MANIFEST_V2,
+                  "seg_targets": self.segment_targets}
+        data = b"".join(json.dumps(r, sort_keys=True).encode() + b"\n"
+                        for r in [header] + merged)
+        from racon_tpu.obs.metrics import record_ckpt
+        from racon_tpu.utils.atomicio import atomic_write_bytes
+        self._manifest.close()
+        atomic_write_bytes(self.manifest_path, data)
+        self._manifest = open(self.manifest_path, "ab")
+        self._seg_log = merged
+        self._sealed_since_compact = 0
+        record_ckpt("compaction", 0, len(data))
+
     def commit(self, tid: int, name: bytes, data: bytes) -> None:
         """Durably store target ``tid``'s emitted FASTA record.
 
         Write order is the crash-consistency contract: shard bytes
         reach disk before the manifest record that references them, and
         the first commit also fsyncs the directory so the files'
-        entries survive power loss.
-        """
+        entries survive power loss. A v2 store flushes the shard write
+        immediately (so ``read_emitted`` serves live bytes) but defers
+        the fsync-pair to the segment seal — the target is durable only
+        once its segment is."""
         if self._shard is None or self._manifest is None:
             raise CheckpointError(
                 "[racon_tpu::checkpoint] commit on a closed store")
@@ -293,9 +483,21 @@ class CheckpointStore:
         from racon_tpu.resilience.faults import maybe_fault
         maybe_fault("ckpt/commit")
         blob = b">" + name + b"\n" + data + b"\n"
+        if self.segment_targets:
+            off = self._shard_pos
+            self._shard.write(blob)
+            self._shard.flush()
+            self._shard_pos = off + len(blob)
+            rec = {"ev": "contig", "tid": int(tid),
+                   "offset": off, "length": len(blob)}
+            self.committed[int(tid)] = rec
+            record_ckpt("commit", tid, len(blob))
+            self._buffer_commit(tid, off, len(blob))
+            return
         off = append_fsync(self._shard, blob,
                            sync_dir=None if self._dir_synced
                            else self.directory)
+        self._shard_pos = off + len(blob)
         rec = {"ev": "contig", "tid": int(tid),
                "name": name.decode("utf-8", "replace"),
                "offset": off, "length": len(blob)}
@@ -313,6 +515,11 @@ class CheckpointStore:
         from racon_tpu.resilience.faults import maybe_fault
         maybe_fault("ckpt/commit")
         rec = {"ev": "contig", "tid": int(tid), "emitted": False}
+        if self.segment_targets:
+            self.committed[int(tid)] = rec
+            record_ckpt("commit", tid, 0)
+            self._buffer_commit(tid, self._shard_pos, 0)
+            return
         self._append_manifest(rec)
         self.committed[int(tid)] = rec
         record_ckpt("commit", tid, 0)
@@ -333,6 +540,13 @@ class CheckpointStore:
         return blob
 
     def close(self) -> None:
+        if self._seg and self._shard is not None \
+                and self._manifest is not None:
+            # A v2 store seals its partial tail segment on the way
+            # out: the worker closes its store before marking the
+            # shard done (distributed/worker._polish_shard), so a done
+            # marker always implies a fully sealed manifest.
+            self._seal_segment()
         for fh in (self._shard, self._manifest):
             if fh is not None:
                 try:

@@ -243,20 +243,22 @@ class PolishServer:
         fleet, from the job's target count and the current admission
         queue depth. Recorded as a ``gate`` span + counter so the
         per-job timeline shows the decision between submit and run."""
-        from racon_tpu.gateway.dispatch import (count_targets,
-                                                decide_route,
+        from racon_tpu.gateway.dispatch import (decide_route,
                                                 fleet_enabled,
-                                                fleet_paths)
+                                                fleet_paths,
+                                                target_stats)
         from racon_tpu.obs.metrics import record_gate
-        n_targets = 0
+        n_targets = target_bytes = 0
         if fleet_enabled():
             try:
-                n_targets = count_targets(job.spec.targets)
+                n_targets, target_bytes = target_stats(job.spec.targets)
             except Exception:
-                n_targets = 0  # unreadable inputs fail later, locally
+                n_targets = target_bytes = 0  # unreadable inputs fail
+                #                               later, locally
         with self._lock:
             depth = self._queued
-        decision = decide_route(job.spec, n_targets, depth)
+        decision = decide_route(job.spec, n_targets, depth,
+                                target_bytes=target_bytes)
         if decision.route == "fleet" and store.committed:
             # A job that started locally (committed prefix but no
             # fleet run dir) must finish locally: local stores number
@@ -274,7 +276,8 @@ class PolishServer:
                     parent_id=job.trace.parent_id if job.trace else 0,
                     decision=decision.route, reason=decision.reason,
                     n_targets=decision.n_targets,
-                    queue_depth=decision.queue_depth)
+                    queue_depth=decision.queue_depth,
+                    target_bytes=decision.target_bytes)
         return decision
 
     def _run_fleet(self, job: Job, store) -> None:

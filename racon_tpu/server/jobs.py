@@ -45,25 +45,30 @@ class JobCancelled(Exception):
 
 
 class Job:
-    """One submitted polishing job: journal record + in-memory result
-    stream. The runner thread is the only writer of ``chunks`` (list
-    appends are atomic), so HTTP streamers snapshot it lock-free."""
+    """One submitted polishing job: journal record + result stream.
+    The stream is a :class:`~racon_tpu.ava.emit.RecordSpool` — a plain
+    in-memory chunk list for kC-sized results, spilling to a
+    job-directory scratch file past ``RACON_TPU_SERVE_SPOOL_MB`` so an
+    ava job's millions of records never pin millions of live objects.
+    The spool is internally locked; runner appends and HTTP streamer
+    reads interleave safely."""
 
     __slots__ = ("id", "tenant", "spec", "directory", "state", "error",
-                 "chunks", "cancel", "finished", "n_committed",
+                 "spool", "cancel", "finished", "n_committed",
                  "trace", "t_submit")
 
     def __init__(self, job_id: str, tenant: str, spec: JobSpec,
                  directory: str, state: str = "queued",
                  error: Optional[str] = None,
                  trace: Optional[TraceContext] = None):
+        from racon_tpu.ava.emit import RecordSpool
         self.id = job_id
         self.tenant = tenant
         self.spec = spec
         self.directory = directory
         self.state = state
         self.error = error
-        self.chunks: List[bytes] = []
+        self.spool = RecordSpool(directory)
         self.cancel = threading.Event()
         self.finished = threading.Event()
         self.n_committed = 0
@@ -81,10 +86,10 @@ class Job:
     def emit(self, blob: bytes) -> None:
         """The ``polish_job`` byte sink — committed-prefix re-emission
         and fresh records arrive here in target order."""
-        self.chunks.append(blob)
+        self.spool.append(blob)
 
     def result_bytes(self) -> bytes:
-        return b"".join(list(self.chunks))
+        return self.spool.read_all()
 
     # ------------------------------------------------------- journal
 
@@ -117,7 +122,7 @@ class Job:
         return {"id": self.id, "tenant": self.tenant,
                 "state": self.state, "error": self.error,
                 "committed": self.n_committed,
-                "bytes": sum(len(c) for c in list(self.chunks)),
+                "bytes": self.spool.total_bytes,
                 "trace": self.trace.encode() if self.trace else ""}
 
 
@@ -150,13 +155,19 @@ def open_store(job: Job):
     """The job's checkpoint store: resumed when its meta exists (daemon
     restart), created fresh otherwise. Identity runs through
     JobSpec.fingerprint(), so a tampered input or edited spec refuses
-    to resume instead of silently mixing outputs."""
+    to resume instead of silently mixing outputs. Fresh stores for
+    fragment-correction jobs get the v2 segmented manifest
+    (ava.seg_targets_for); resumed stores keep whatever flavor their
+    header records."""
+    from racon_tpu.ava import seg_targets_for
     from racon_tpu.resilience.checkpoint import CheckpointStore
     fingerprint = job.spec.fingerprint()
     probe = CheckpointStore(job.ckpt_dir, fingerprint)
     if os.path.isfile(probe.meta_path):
         return CheckpointStore.resume(job.ckpt_dir, fingerprint)
-    return CheckpointStore.create(job.ckpt_dir, fingerprint)
+    return CheckpointStore.create(
+        job.ckpt_dir, fingerprint,
+        segment_targets=seg_targets_for(job.spec.fragment_correction))
 
 
 def rebuild_result(job: Job) -> None:
@@ -168,12 +179,11 @@ def rebuild_result(job: Job) -> None:
     store = CheckpointStore.resume(job.ckpt_dir,
                                    job.spec.fingerprint())
     try:
-        chunks: List[bytes] = []
+        job.spool.reset()
         for tid in sorted(store.committed):
             blob = store.read_emitted(tid)
             if blob is not None:
-                chunks.append(blob)
-        job.chunks = chunks
+                job.spool.append(blob)
         job.n_committed = len(store.committed)
     finally:
         store.close()

@@ -66,6 +66,20 @@ def read(name: str) -> str:
 # The registry.  Grouped by doc file; keep alphabetical within groups.
 # --------------------------------------------------------------------
 
+# docs/AVA.md — assembly-scale all-vs-all planning
+declare("RACON_TPU_AVA_COMPACT", "", "int", "AVA.md",
+        "sealed v2 manifest segments between compaction rewrites "
+        "(default 64; 0 disables compaction)")
+declare("RACON_TPU_AVA_COMPILE_BUDGET", "", "int", "AVA.md",
+        "max distinct shape buckets the ava planner may emit; the "
+        "bucket quantum doubles until the plan fits (default 8)")
+declare("RACON_TPU_AVA_SEG", "", "int", "AVA.md",
+        "checkpoint-manifest targets per v2 segment record; unset = "
+        "256 for ava runs and 0 (v1 per-target records) for kC")
+declare("RACON_TPU_AVA_WEIGHTED", "1", "flag", "AVA.md",
+        "length-weighted shard partitioning when target offsets are "
+        "published (default on; 0 = count-balanced bounds)")
+
 # docs/CACHE.md — content-addressed result cache
 declare("RACON_TPU_CACHE", "", "flag", "CACHE.md",
         "result-cache master gate: on by default for the daemon (the "
@@ -108,6 +122,10 @@ declare("RACON_TPU_SPLIT_DEPTH", "", "int", "DISTRIBUTED.md",
 declare("RACON_TPU_GATE_FLEET", "0", "flag", "GATEWAY.md",
         "fleet-serve gate: route eligible daemon jobs to an "
         "autoscaled ledger fleet (default off = all jobs in-process)")
+declare("RACON_TPU_GATE_FLEET_MIN_BYTES", "8388608", "int", "GATEWAY.md",
+        "ava routing size threshold: fragment-correction jobs whose "
+        "targets file is at least this many bytes go to the fleet "
+        "(target COUNT misprices read-sized targets)")
 declare("RACON_TPU_GATE_FLEET_MIN_TARGETS", "32", "int", "GATEWAY.md",
         "routing size threshold: jobs with at least this many target "
         "contigs go to the fleet")
@@ -221,6 +239,10 @@ declare("RACON_TPU_SERVE_MAX_JOBS", "4", "int", "SERVER.md",
         "max concurrently running jobs (admission semaphore)")
 declare("RACON_TPU_SERVE_QUEUE", "64", "int", "SERVER.md",
         "bounded admission queue depth in work items")
+declare("RACON_TPU_SERVE_SPOOL_MB", "", "int", "SERVER.md",
+        "in-memory result bytes per job before the stream spills to "
+        "the job-directory spool file (default 8 MiB; 0 = never "
+        "spill)")
 
 # docs/SCHEDULER.md — shape-bucket scheduler
 declare("RACON_TPU_ADAPTIVE", "", "flag", "SCHEDULER.md",

@@ -1,0 +1,262 @@
+"""CI smoke: assembly-scale all-vs-all fragment correction through the
+ava planner subsystem (racon_tpu/ava/, docs/AVA.md), end to end
+through real processes.
+
+The drill: an ava read set (``--reads``, default 10,000; skewed — a
+long-read head, a short-read tail, so count- and byte-balanced
+partitions genuinely differ) corrected with ``-f`` (every read is a
+target) three ways —
+
+1. serial CLI: the golden bytes;
+2. fleet worker A on a shared work ledger, hard-killed mid-run
+   (``dist/contig:<k>!kill`` — the one injected eviction);
+3. fleet worker B (clock skew outruns A's stale lease): steals A's
+   shard, resumes the committed prefix, finishes every shard, merges.
+
+Gates:
+- the merged fleet output is **byte-identical** to the serial run;
+- the ledger published **length-weighted** shard bounds (different
+  from the count partition on this skewed set, same cover invariants);
+- every shard's checkpoint manifest is **v2 segmented**: run-length
+  ``seg`` records only, amortized far below one record per target —
+  the o(1)-metadata acceptance bar;
+- the worker logged its shape-bucket plan (compile keys within the
+  ``RACON_TPU_AVA_COMPILE_BUDGET``) and the survivor's trace footer
+  accounts the steal, the resumed prefix, and the v2 seals.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np                                   # noqa: E402
+
+BASES = np.frombuffer(b"ACGT", np.uint8)
+BOOT = "import sys; from racon_tpu import cli; sys.exit(cli.main(sys.argv[1:]))"
+
+
+FAMILY = 4
+
+
+def _write_inputs(d, n_reads):
+    """``n_reads`` reads with a skewed length mix (5% long head, 95%
+    short tail — IN THAT ORDER, so byte-balanced bounds must cut the
+    head finer than the count partition would). Reads come in families
+    of ``FAMILY`` noisy copies of a shared truth (so the all-vs-all
+    overlaps are genuine alignments, not filtered out as spurious),
+    with ring overlaps within each family, both PAF directions."""
+    assert n_reads >= 2, "need at least one overlap pair"
+    rng = np.random.default_rng(23)
+    n_long = max(FAMILY, n_reads // 20)
+    sizes = [FAMILY] * (n_reads // FAMILY)
+    rem = n_reads % FAMILY
+    if rem == 1 and sizes:
+        sizes[-1] += 1       # no singleton families (no self-overlap)
+    elif rem:
+        sizes.append(rem)
+    reads, paf = [], []
+    i = 0
+    for fam in sizes:
+        ln = int(rng.integers(400, 700)) if i < n_long \
+            else int(rng.integers(40, 90))
+        truth = BASES[rng.integers(0, 4, ln)]
+        names = []
+        for _ in range(fam):
+            out = []
+            for b in truth:
+                r = rng.random()
+                if r < 0.03:
+                    continue
+                out.append(int(BASES[rng.integers(0, 4)]) if r < 0.06
+                           else int(b))
+            data = bytes(out)
+            name = f"r{i + len(names)}"
+            names.append((name, len(data)))
+            reads.append(b">" + name.encode() + b"\n" + data + b"\n")
+        for j in range(len(names)):
+            qn, ql = names[j]
+            tn, tl = names[(j + 1) % len(names)]
+            if qn == tn:
+                continue
+            m, al = min(ql, tl), max(ql, tl)
+            paf.append(f"{qn}\t{ql}\t0\t{ql}\t+\t{tn}\t{tl}\t0\t{tl}"
+                       f"\t{m}\t{al}\t60")
+            paf.append(f"{tn}\t{tl}\t0\t{tl}\t+\t{qn}\t{ql}\t0\t{ql}"
+                       f"\t{m}\t{al}\t60")
+        i += fam
+    with open(os.path.join(d, "reads.fasta"), "wb") as fh:
+        fh.write(b"".join(reads))
+    with open(os.path.join(d, "ava.paf"), "w") as fh:
+        fh.write("\n".join(paf) + "\n")
+
+
+def _cmd(d, *extra):
+    # Native backend: this smoke drills the ava planning/ledger/manifest
+    # machinery, which is backend-agnostic — and at 10k reads the
+    # per-window jax dispatch on a CPU-only CI box would turn a
+    # 2-minute drill into an hour. Byte-identity is native vs native.
+    return [sys.executable, "-c", BOOT, "--backend", "native", "-f",
+            *extra,
+            os.path.join(d, "reads.fasta"), os.path.join(d, "ava.paf"),
+            os.path.join(d, "reads.fasta")]
+
+
+def _env(**overrides):
+    e = dict(os.environ)
+    for k in ("RACON_TPU_FAULTS", "RACON_TPU_TRACE"):
+        e.pop(k, None)
+    e.update(overrides)
+    return e
+
+
+def _metrics_footer(trace_path):
+    with open(trace_path) as fh:
+        for line in fh:
+            rec = json.loads(line)
+            if rec.get("ev") == "metrics":
+                return rec
+    raise AssertionError(f"no metrics footer in {trace_path}")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--reads", type=int, default=10_000,
+                    help="ava read-set size (every read is a target)")
+    args = ap.parse_args()
+    n_reads = args.reads
+
+    with tempfile.TemporaryDirectory() as d:
+        _write_inputs(d, n_reads)
+
+        # Serial golden: the bytes the fleet must reproduce.
+        proc = subprocess.run(_cmd(d), capture_output=True, env=_env())
+        assert proc.returncode == 0, proc.stderr.decode()[-2000:]
+        base = proc.stdout
+        assert base.count(b">") == n_reads, \
+            f"serial kF emitted {base.count(b'>')}/{n_reads} reads"
+        print(f"[ava-smoke] serial golden: {n_reads} reads, "
+              f"{len(base)} bytes", flush=True)
+
+        ledger = os.path.join(d, "ledger")
+
+        # Worker A: hard-killed mid-run after committing a real prefix.
+        # Fleet runs pin RACON_TPU_AVA_SEG=32 so the victim has *sealed*
+        # segments behind it when it dies (v2 recovery drops only the
+        # unsealed tail; at the default 256 a small-prefix kill would
+        # legitimately resume nothing).
+        seg = 32
+        kill_at = max(seg + seg // 2, n_reads // 50)
+        a = subprocess.Popen(
+            _cmd(d, "--ledger-dir", ledger, "--workers", "2",
+                 "--worker-id", "A"),
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            env=_env(RACON_TPU_FAULTS=f"dist/contig:{kill_at}!kill",
+                     RACON_TPU_AVA_SEG=str(seg)))
+        a_out, a_err = a.communicate(timeout=900)
+        assert a.returncode == 137, \
+            f"A: expected kill 137, got {a.returncode}: " \
+            f"{a_err.decode()[-2000:]}"
+        assert a_out == b"", "evicted worker must not emit output"
+        print(f"[ava-smoke] worker A evicted after ~{kill_at} commits "
+              "(137)", flush=True)
+
+        # Worker B: outruns A's stale lease, steals, finishes, merges.
+        trace = os.path.join(d, "b.jsonl")
+        b = subprocess.Popen(
+            _cmd(d, "--ledger-dir", ledger, "--workers", "2",
+                 "--worker-id", "B"),
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            env=_env(RACON_TPU_FAULTS="skew=99999",
+                     RACON_TPU_AVA_SEG=str(seg),
+                     RACON_TPU_TRACE=trace))
+        b_out, b_err = b.communicate(timeout=900)
+        assert b.returncode == 0, b_err.decode()[-2000:]
+
+        # Gate 1: byte identity.
+        assert b_out == base, \
+            "fleet-merged ava output differs from serial CLI"
+        assert open(os.path.join(ledger, "out.fasta"),
+                    "rb").read() == base
+        print("[ava-smoke] fleet output byte-identical to serial",
+              flush=True)
+
+        # Gate 2: the published bounds are length-weighted — they cut
+        # the long-read head finer than the count partition.
+        meta = json.load(open(os.path.join(ledger, "meta.json")))
+        bounds = meta["bounds"]
+        n_shards = len(bounds) - 1
+        count_bounds = [round(n_reads * k / n_shards)
+                        for k in range(n_shards + 1)]
+        assert bounds[0] == 0 and bounds[-1] == n_reads
+        assert all(bounds[i] < bounds[i + 1] for i in range(n_shards))
+        assert bounds != count_bounds, \
+            f"expected weighted bounds on skewed input, got the " \
+            f"count partition {bounds}"
+        assert bounds[1] < count_bounds[1], \
+            f"weighted bounds should cut the heavy head early: " \
+            f"{bounds} vs count {count_bounds}"
+        print(f"[ava-smoke] weighted bounds {bounds} "
+              f"(count partition would be {count_bounds})", flush=True)
+
+        # Gate 3: v2 segmented manifests — run-length records only,
+        # amortized far below one record per target.
+        seg_records = 0
+        covered = 0
+        for name in sorted(os.listdir(ledger)):
+            man = os.path.join(ledger, name, "manifest.jsonl")
+            if not name.startswith("shard_") or not os.path.isfile(man):
+                continue
+            for line in open(man, "rb").read().splitlines():
+                rec = json.loads(line)
+                if rec.get("ev") == "begin":
+                    assert rec.get("manifest") == 2, \
+                        f"{name}: expected a v2 manifest header: {rec}"
+                elif rec.get("ev") == "seg":
+                    seg_records += 1
+                    covered += int(rec["end"]) - int(rec["start"])
+                else:
+                    raise AssertionError(
+                        f"{name}: per-target record in a v2 manifest: "
+                        f"{rec}")
+        assert covered >= n_reads, \
+            f"segments cover {covered}/{n_reads} targets"
+        assert seg_records * 8 <= n_reads, \
+            f"{seg_records} manifest records for {n_reads} targets — " \
+            "segment amortization failed"
+        print(f"[ava-smoke] {seg_records} segment record(s) cover "
+              f"{covered} targets (v2 manifests, "
+              f"{covered // max(1, seg_records)} targets/record)",
+              flush=True)
+
+        # Gate 4: the shape-bucket plan was published under budget, and
+        # the survivor's footer accounts the steal + resume + seals.
+        b_err_text = b_err.decode()
+        assert "[racon_tpu::ava] worker:" in b_err_text, \
+            "worker never logged its shape-bucket plan"
+        m = _metrics_footer(trace)
+        assert m.get("ava_targets", 0) == n_reads, m.get("ava_targets")
+        budget = int(m.get("ava_compile_budget", 0))
+        assert 0 < m.get("ava_buckets", 0) <= budget, \
+            f"bucket plan over budget: {m.get('ava_buckets')} > {budget}"
+        assert m.get("dist_shards_stolen", 0) >= 1, \
+            "survivor never stole the evicted worker's shard"
+        assert m.get("dist_contigs_resumed", 0) >= 1, \
+            "victim's committed prefix was not resumed"
+        assert m.get("res_ckpt_seals", 0) >= 1, \
+            "no v2 segment seals recorded"
+        print(f"[ava-smoke] plan: {int(m['ava_buckets'])} bucket(s) "
+              f"within budget {budget}; survivor stole "
+              f"{int(m['dist_shards_stolen'])} shard(s), resumed "
+              f"{int(m['dist_contigs_resumed'])} committed target(s), "
+              f"{int(m['res_ckpt_seals'])} seal(s)", flush=True)
+
+    print("[ava-smoke] PASS", flush=True)
+
+
+if __name__ == "__main__":
+    main()

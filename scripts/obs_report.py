@@ -278,6 +278,7 @@ def render(tr: Dict[str, object], out=None,
     _render_server(m, by_kind, out, trace_end_unix=_trace_end_unix(tr))
     _render_hist(m, out)
     _render_cache(m, by_kind, out)
+    _render_ava(m, out)
     if fleet_dir:
         _render_fleet(fleet_dir, out)
     _render_redo(m, out)
@@ -652,6 +653,29 @@ def _render_cache(m, by_kind, out) -> None:
         tiers = ", ".join(f"{t}: {n}" for t, n in
                           sorted(by_tier.items()))
         print(f"  events by tier: {tiers}", file=out)
+
+
+def _render_ava(m, out) -> None:
+    """The "ava:" section: the shape-bucket plan (targets, buckets vs
+    the compile budget, quantum, padding overhead) and — when the ava
+    bench ran — throughput, peak RSS, and the v2 manifest's bytes per
+    committed target (docs/AVA.md). kC runs record no ``ava_*`` keys
+    and print nothing."""
+    m = m or {}
+    if not any(k.startswith("ava_") for k in m):
+        return
+    print(f"\nava: targets={int(m.get('ava_targets', 0))}  "
+          f"buckets={int(m.get('ava_buckets', 0))}"
+          f"/{int(m.get('ava_compile_budget', 0))}  "
+          f"quantum={int(m.get('ava_quantum', 0))}  "
+          f"pad_frac={float(m.get('ava_pad_frac', 0) or 0):.4f}",
+          file=out)
+    if m.get("ava_reads_per_sec") is not None:
+        print(f"  reads/s={float(m.get('ava_reads_per_sec', 0)):.1f}  "
+              f"peak_rss={float(m.get('ava_peak_rss_mb', 0)):.1f}MB  "
+              f"manifest_bytes/target="
+              f"{float(m.get('ava_manifest_bytes_per_target', 0)):.2f}",
+              file=out)
 
 
 def _render_fleet(fleet_dir: str, out) -> None:

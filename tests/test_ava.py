@@ -1,0 +1,372 @@
+"""Ava planner subsystem tests: length-weighted partitioning (and its
+adoption by the work ledger), the shape-bucket planner, the record
+spool, byte-aware gateway routing, and the kF single-parse path
+(racon_tpu/ava/, docs/AVA.md)."""
+
+import contextlib
+import io
+import os
+
+import numpy as np
+import pytest
+
+from racon_tpu import ava
+from racon_tpu.ava import emit as ava_emit
+from racon_tpu.ava import partition as ava_part
+from racon_tpu.ava import planner as ava_plan
+from racon_tpu.gateway import dispatch as gw_dispatch
+from racon_tpu.gateway.dispatch import RouteDecision, decide_route
+from racon_tpu.obs import metrics as obs_metrics
+from racon_tpu.ops import budget as ops_budget
+from racon_tpu.server.engine import JobSpec
+
+BASES = np.frombuffer(b"ACGT", np.uint8)
+
+AVA_ENVS = (ava.ENV_AVA_SEG, ava_part.ENV_AVA_WEIGHTED,
+            ops_budget.ENV_AVA_COMPILE_BUDGET,
+            ava_emit.ENV_SERVE_SPOOL,
+            gw_dispatch.ENV_GATE_FLEET, gw_dispatch.ENV_MIN_TARGETS,
+            gw_dispatch.ENV_MIN_BYTES, gw_dispatch.ENV_QUEUE_PRESSURE)
+
+
+@pytest.fixture(autouse=True)
+def ava_sandbox(monkeypatch):
+    for env in AVA_ENVS:
+        monkeypatch.delenv(env, raising=False)
+    obs_metrics.reset()
+    yield
+    obs_metrics.reset()
+
+
+# ----------------------------------------------- weighted partitioning
+
+
+def test_uniform_weights_match_count_partition():
+    """Equal weights reproduce the count partition when it divides
+    evenly, and stay within one target of it otherwise (the two round
+    the remainder differently, never more)."""
+    from racon_tpu.distributed.ledger import _partition
+    for n, k in ((6, 3), (100, 4), (5, 5), (8, 2)):
+        assert ava_part.weighted_partition(n, k, [10] * n) == \
+            _partition(n, k)
+    for n, k in ((7, 3), (100, 8)):
+        w = ava_part.weighted_partition(n, k, [10] * n)
+        sizes = [w[i + 1] - w[i] for i in range(k)]
+        assert max(sizes) - min(sizes) <= 1
+
+
+def test_weighted_partition_balances_bytes_not_counts():
+    """Length-skewed reads (heavy prefix, light tail): the weighted cut
+    lands where the BYTES halve, not where the record count does."""
+    weights = [100] * 10 + [1] * 90
+    bounds = ava_part.weighted_partition(100, 2, weights)
+    assert bounds == [0, 6, 100]
+    half = sum(weights) / 2
+    assert abs(sum(weights[:bounds[1]]) - half) < 100
+    # The count partition would load shard 0 with ~95% of the bytes.
+    assert sum(weights[:50]) > 0.95 * sum(weights)
+    # Degenerate skew — one dominant read: it sits alone in shard 0 and
+    # every shard still owns at least one target.
+    b = ava_part.weighted_partition(100, 4, [10_000] + [10] * 99)
+    assert b[0] == 0 and b[-1] == 100 and b == sorted(set(b))
+    assert b[1] == 1
+
+
+def test_weighted_partition_invariants_random():
+    """Property sweep: contiguous ascending bounds, full cover, >=1
+    target per shard — the invariants every downstream consumer
+    (manifest prefix, split carving, merge tiling) rests on."""
+    rng = np.random.default_rng(7)
+    for _ in range(50):
+        n = int(rng.integers(1, 200))
+        k = int(rng.integers(1, min(n, 12) + 1))
+        w = rng.integers(1, 5000, n).tolist()
+        b = ava_part.weighted_partition(n, k, w)
+        assert b[0] == 0 and b[-1] == n and len(b) == k + 1
+        assert all(b[i] < b[i + 1] for i in range(k))
+
+
+def test_weights_from_offsets_shapes():
+    assert ava_part.weights_from_offsets([]) == []
+    assert ava_part.weights_from_offsets([0]) == [1]
+    # Deltas, with the last target weighing the mean gap.
+    assert ava_part.weights_from_offsets([0, 100, 150]) == [100, 50, 75]
+
+
+def test_weighted_bounds_gate_and_consistency(monkeypatch):
+    offs = [0, 1000, 1010, 1020]
+    assert ava_part.weighted_bounds(4, 2, offs) == [0, 1, 4]
+    # Single shard / inconsistent offsets: keep the count partition.
+    assert ava_part.weighted_bounds(4, 1, offs) is None
+    assert ava_part.weighted_bounds(5, 2, offs) is None
+    monkeypatch.setenv(ava_part.ENV_AVA_WEIGHTED, "0")
+    assert ava_part.weighted_bounds(4, 2, offs) is None
+
+
+def test_ledger_publishes_weighted_bounds(tmp_path):
+    """WorkLedger.open with a scan that returns skewed offsets must
+    publish weighted bounds; a joiner adopts them verbatim."""
+    from racon_tpu.distributed.ledger import WorkLedger
+    offsets = [0, 9000, 9010, 9020, 9030, 9040]
+    led = WorkLedger.open(str(tmp_path / "led"), "fp1", workers=1,
+                          n_shards=2, weighted=True,
+                          scan_targets=lambda: (6, offsets))
+    assert led.bounds == [0, 1, 6]          # not the count split [0,3,6]
+    joiner = WorkLedger.open(str(tmp_path / "led"), "fp1", workers=1)
+    assert joiner.bounds == led.bounds
+
+
+def test_ledger_count_bounds_when_gate_off(tmp_path, monkeypatch):
+    monkeypatch.setenv(ava_part.ENV_AVA_WEIGHTED, "0")
+    from racon_tpu.distributed.ledger import WorkLedger
+    offsets = [0, 9000, 9010, 9020, 9030, 9040]
+    led = WorkLedger.open(str(tmp_path / "led"), "fp1", workers=1,
+                          n_shards=2, weighted=True,
+                          scan_targets=lambda: (6, offsets))
+    assert led.bounds == [0, 3, 6]
+
+
+def test_ledger_kc_open_stays_count_partitioned(tmp_path):
+    """A contig-polish open (weighted unset) keeps the count partition
+    even when the scan supplies skewed offsets — the weighted cut is
+    the kF worker's opt-in, not a side effect of scanning."""
+    from racon_tpu.distributed.ledger import WorkLedger
+    offsets = [0, 9000, 9010, 9020, 9030, 9040]
+    led = WorkLedger.open(str(tmp_path / "led"), "fp1", workers=1,
+                          n_shards=2,
+                          scan_targets=lambda: (6, offsets))
+    assert led.bounds == [0, 3, 6]
+
+
+# --------------------------------------------------- shape-bucket plan
+
+
+def test_plan_buckets_quantizes_and_coalesces():
+    plan = ava_plan.plan_buckets([100, 120, 700, 100], window_length=500)
+    q = ops_budget.ava_bucket_quantum(500)
+    assert plan.quantum == q
+    assert plan.n_targets == 4
+    # 100 and 120 share the 2-quantum bucket; 700 gets its own.
+    assert plan.buckets == ((2 * q, 3), (704, 1))
+    # Input order 100,120,700,100 -> runs: [q, q], [700cap], [q].
+    assert plan.n_runs == 3
+    assert plan.n_buckets == len(plan.compile_keys) == 2
+    assert 0.0 <= plan.pad_frac < 1.0
+
+
+def test_plan_buckets_budget_doubles_quantum():
+    """Millions of distinct lengths must collapse under the compile
+    budget by coarsening, never by dropping targets."""
+    rng = np.random.default_rng(3)
+    lengths = rng.integers(200, 60_000, 5000).tolist()
+    plan = ava_plan.plan_buckets(lengths, window_length=500, budget=8)
+    assert plan.n_buckets <= 8
+    assert plan.quantum > ops_budget.ava_bucket_quantum(500)
+    assert plan.n_targets == 5000
+    assert sum(c for _, c in plan.buckets) == 5000
+    # Tighter budget -> coarser quantum, never a budget violation.
+    tight = ava_plan.plan_buckets(lengths, window_length=500, budget=2)
+    assert tight.n_buckets <= 2
+    assert tight.quantum >= plan.quantum
+
+
+def test_plan_buckets_empty_raises_and_env_budget(monkeypatch):
+    with pytest.raises(ValueError, match="at least one target"):
+        ava_plan.plan_buckets([])
+    monkeypatch.setenv(ops_budget.ENV_AVA_COMPILE_BUDGET, "3")
+    plan = ava_plan.plan_buckets(list(range(100, 50_000, 137)))
+    assert plan.budget == 3 and plan.n_buckets <= 3
+
+
+def test_record_ava_plan_publishes_gauges():
+    plan = ava_plan.plan_buckets([100, 700, 100], window_length=500)
+    obs_metrics.record_ava_plan(plan)
+    snap = obs_metrics.registry().snapshot()
+    assert snap["ava_targets"] == 3
+    assert snap["ava_buckets"] == plan.n_buckets
+    assert snap["ava_quantum"] == plan.quantum
+    assert snap["ava_compile_budget"] == plan.budget
+    assert snap["ava_pad_frac"] == plan.pad_frac
+
+
+# --------------------------------------------------------- record spool
+
+
+def test_record_spool_memory_and_spill_identity(tmp_path):
+    records = [b"rec%03d:" % i + b"x" * i for i in range(64)]
+    # Never-spill (limit 0) vs tiny-limit spill: identical streams.
+    mem = ava_emit.RecordSpool(str(tmp_path), limit_bytes=0)
+    disk = ava_emit.RecordSpool(str(tmp_path), limit_bytes=100)
+    for r in records:
+        mem.append(r)
+        disk.append(r)
+    assert not mem.spilled and disk.spilled
+    assert os.path.exists(os.path.join(str(tmp_path),
+                                       ava_emit.SPOOL_FILE))
+    want = b"".join(records)
+    assert mem.read_all() == disk.read_all() == want
+    assert mem.total_bytes == disk.total_bytes == len(want)
+    # Reads interleave with appends past the spill point.
+    disk.append(b"tail")
+    assert disk.read_all() == want + b"tail"
+    disk.reset()
+    assert disk.total_bytes == 0 and disk.read_all() == b""
+    assert not os.path.exists(os.path.join(str(tmp_path),
+                                           ava_emit.SPOOL_FILE))
+    mem.close()
+    disk.close()
+
+
+def test_record_spool_no_directory_never_spills():
+    sp = ava_emit.RecordSpool(None, limit_bytes=4)
+    for _ in range(32):
+        sp.append(b"abcdefgh")
+    assert not sp.spilled and len(sp.read_all()) == 256
+
+
+def test_iter_fasta_records_matches_split(tmp_path):
+    blob = b">a desc\nACGT\nTTTT\n>b\nCC\n>c\nGGGG\n"
+    p = tmp_path / "out.fasta"
+    p.write_bytes(blob)
+    recs = list(ava_emit.iter_fasta_records(str(p)))
+    assert recs == gw_dispatch._split_fasta(blob)
+    assert b"".join(recs) == blob
+    p.write_bytes(b"")
+    assert list(ava_emit.iter_fasta_records(str(p))) == []
+
+
+# --------------------------------------------------- byte-aware routing
+
+
+def test_route_ava_jobs_size_by_bytes(monkeypatch):
+    monkeypatch.setenv(gw_dispatch.ENV_GATE_FLEET, "1")
+    monkeypatch.setenv(gw_dispatch.ENV_MIN_TARGETS, "4")
+    monkeypatch.setenv(gw_dispatch.ENV_MIN_BYTES, "1000")
+    monkeypatch.setenv(gw_dispatch.ENV_QUEUE_PRESSURE, "2")
+    spec = JobSpec("r.fa", "o.paf", "r.fa", fragment_correction=True)
+
+    # Few records but a megabyte of reads: bytes say fleet even though
+    # the count threshold never fires.
+    d = decide_route(spec, 3, queue_depth=0, target_bytes=5000)
+    assert d.route == "fleet" and "target_bytes 5000 >= 1000" in d.reason
+    assert d.target_bytes == 5000
+    # Many tiny records, few bytes: count would misroute to the fleet;
+    # bytes keep it local.
+    d = decide_route(spec, 400, queue_depth=0, target_bytes=800)
+    assert d.route == "local" and "target_bytes 800 < 1000" in d.reason
+    # Queue pressure overrides in the ava regime too.
+    d = decide_route(spec, 1, queue_depth=2, target_bytes=10)
+    assert d.route == "fleet" and "queue_depth" in d.reason
+    # Unarmed gateway: ava jobs stay local like everything else.
+    monkeypatch.delenv(gw_dispatch.ENV_GATE_FLEET)
+    d = decide_route(spec, 3, queue_depth=9, target_bytes=10**9)
+    assert d == RouteDecision("local", "fleet-disabled", 3, 9, 10**9)
+
+
+def test_route_non_ava_jobs_unchanged_by_bytes(monkeypatch):
+    """A kC spec (and the policy tests' spec=None) still routes purely
+    by count — target_bytes rides along for the gate span only."""
+    monkeypatch.setenv(gw_dispatch.ENV_GATE_FLEET, "1")
+    monkeypatch.setenv(gw_dispatch.ENV_MIN_TARGETS, "4")
+    monkeypatch.setenv(gw_dispatch.ENV_MIN_BYTES, "1")
+    d = decide_route(None, 3, queue_depth=0, target_bytes=10**9)
+    assert d.route == "local"
+    spec = JobSpec("r.fa", "o.paf", "d.fa")
+    d = decide_route(spec, 4, queue_depth=0, target_bytes=0)
+    assert d.route == "fleet" and "n_targets" in d.reason
+
+
+def test_target_stats_returns_count_and_bytes(tmp_path):
+    p = tmp_path / "t.fasta"
+    p.write_bytes(b">c0\nACGT\n>c1\nAC\n")
+    assert gw_dispatch.target_stats(str(p)) == (2, 16)
+
+
+# ------------------------------------------------- segment-size policy
+
+
+def test_seg_targets_for_regimes(monkeypatch):
+    assert ava.seg_targets_for(True) == ava.DEFAULT_SEG_TARGETS
+    assert ava.seg_targets_for(False) == 0
+    monkeypatch.setenv(ava.ENV_AVA_SEG, "64")
+    assert ava.seg_targets_for(True) == 64
+    assert ava.seg_targets_for(False) == 64   # explicit env wins
+    monkeypatch.setenv(ava.ENV_AVA_SEG, "0")
+    assert ava.seg_targets_for(True) == 0
+    monkeypatch.setenv(ava.ENV_AVA_SEG, "junk")
+    assert ava.seg_targets_for(True) == 0     # malformed: fail safe, v1
+
+
+# ----------------------------------------------------- kF single-parse
+
+
+def _write_ava_inputs(d, n_reads=8, rlen=220):
+    rng = np.random.default_rng(13)
+    truth = BASES[rng.integers(0, 4, rlen)]
+    reads, paf = [], []
+    names = []
+    for i in range(n_reads):
+        out = []
+        for b in truth:
+            r = rng.random()
+            if r < 0.03:
+                continue
+            out.append(int(BASES[rng.integers(0, 4)]) if r < 0.06
+                       else int(b))
+        data = bytes(out)
+        name = f"read{i}"
+        names.append((name, len(data)))
+        reads.append(b">" + name.encode() + b"\n" + data + b"\n")
+    for i in range(n_reads):
+        qn, ql = names[i]
+        tn, tl = names[(i + 1) % n_reads]
+        paf.append(f"{qn}\t{ql}\t0\t{ql}\t+\t{tn}\t{tl}\t0\t{tl}"
+                   f"\t{min(ql, tl)}\t{max(ql, tl)}\t60")
+        paf.append(f"{tn}\t{tl}\t0\t{tl}\t+\t{qn}\t{ql}\t0\t{ql}"
+                   f"\t{min(ql, tl)}\t{max(ql, tl)}\t60")
+    (d / "reads.fasta").write_bytes(b"".join(reads))
+    (d / "ava.paf").write_text("\n".join(paf) + "\n")
+
+
+class _PoisonParser:
+    """Stands in for the reads parser on the shared-path run: the
+    polisher may look at .path (the single-parse detection) but any
+    parse attempt means the reads file was read twice."""
+
+    def __init__(self, path):
+        self.path = path
+
+    def __getattr__(self, name):
+        raise AssertionError(
+            f"kF single-parse violated: reads parser used ({name})")
+
+
+def _kf_polish(reads_path, paf_path, targets_path, poison=False):
+    from racon_tpu.models.polisher import PolisherType, create_polisher
+    p = create_polisher(reads_path, paf_path, targets_path,
+                        PolisherType.kF, 500, 10.0, 0.3, 1, -1, -1,
+                        backend="native")
+    p.engine.refine_rounds = 1
+    if poison:
+        p.sparser = _PoisonParser(reads_path)
+    with contextlib.redirect_stderr(io.StringIO()):
+        p.initialize()
+        return p.polish(False)
+
+
+def test_kf_single_parse_byte_identity(tmp_path):
+    """The double-parse fix: reads==targets file parses once, and the
+    output is identical to feeding the same content through two
+    distinct files (which forces the two-parse path)."""
+    _write_ava_inputs(tmp_path)
+    reads = str(tmp_path / "reads.fasta")
+    paf = str(tmp_path / "ava.paf")
+    copy = str(tmp_path / "reads_copy.fasta")
+    with open(reads, "rb") as src, open(copy, "wb") as dst:
+        dst.write(src.read())
+
+    shared = _kf_polish(reads, paf, reads, poison=True)
+    twofile = _kf_polish(copy, paf, reads)
+    assert len(shared) == len(twofile) == 8
+    assert [s.name for s in shared] == [s.name for s in twofile]
+    assert [s.data for s in shared] == [s.data for s in twofile]

@@ -319,6 +319,171 @@ def test_run_fingerprint_sensitivity(tmp_path):
     assert base != ckpt.run_fingerprint({"match": 5}, [str(p)])
 
 
+# ----------------------------------- v2 segmented manifests (docs/AVA.md)
+
+
+def test_v2_roundtrip_segments_and_dropped(tmp_path):
+    """A segment_targets=4 store amortizes 5 commits (one dropped) into
+    2 run-length manifest records, and resume expands them back into
+    per-target records indistinguishable from v1's."""
+    d = str(tmp_path / "ck")
+    with ckpt.CheckpointStore.create(d, "fp1",
+                                     segment_targets=4) as store:
+        store.commit(0, b"r0", b"ACGTA")
+        store.commit(1, b"r1", b"TTT")
+        store.commit_dropped(2)
+        store.commit(3, b"r3", b"GG")       # seals segment [0, 4)
+        store.commit(4, b"r4", b"CCCC")     # tail: sealed at close()
+
+    recs = [json.loads(x) for x in
+            open(os.path.join(d, ckpt.MANIFEST_NAME),
+                 "rb").read().splitlines()]
+    assert recs[0]["manifest"] == ckpt.MANIFEST_V2
+    assert recs[0]["seg_targets"] == 4
+    assert [r["ev"] for r in recs[1:]] == ["seg", "seg"]
+    assert recs[1] == {"ev": "seg", "start": 0, "end": 4, "offset": 0,
+                       "lengths": [10, 8, 0, 7]}   # >name\ndata\n blobs
+
+    res = ckpt.CheckpointStore.resume(d, "fp1")
+    assert res.segment_targets == 4         # mode from the header
+    assert sorted(res.committed) == [0, 1, 2, 3, 4]
+    assert res.read_emitted(0) == b">r0\nACGTA\n"
+    assert res.read_emitted(2) is None
+    assert res.read_emitted(4) == b">r4\nCCCC\n"
+    # Unsealed commits still serve live bytes (flushed, not yet fsync'd).
+    res.commit(5, b"r5", b"AA")
+    assert res.read_emitted(5) == b">r5\nAA\n"
+    res.close()
+    snap = obs_metrics.registry().snapshot()
+    assert snap["res_ckpt_commits"] == 6
+    assert snap["res_ckpt_seals"] == 3      # full, close, post-resume close
+
+
+def test_v2_discontinuity_seals_segment(tmp_path):
+    """A target-id gap (shard bounds are contiguous, but a worker can
+    skip ahead after a steal) must seal the open segment — run-length
+    records cannot span a hole."""
+    d = str(tmp_path / "ck")
+    with ckpt.CheckpointStore.create(d, "fp1",
+                                     segment_targets=8) as store:
+        store.commit(0, b"r0", b"AA")
+        store.commit(1, b"r1", b"CC")
+        store.commit(5, b"r5", b"GG")       # gap: seals [0, 2) first
+    recs = [json.loads(x) for x in
+            open(os.path.join(d, ckpt.MANIFEST_NAME),
+                 "rb").read().splitlines()]
+    segs = [(r["start"], r["end"]) for r in recs if r["ev"] == "seg"]
+    assert segs == [(0, 2), (5, 6)]
+    res = ckpt.CheckpointStore.resume(d, "fp1")
+    assert sorted(res.committed) == [0, 1, 5]
+    assert res.read_emitted(5) == b">r5\nGG\n"
+    res.close()
+
+
+def test_v2_crash_loses_at_most_unsealed_segment(tmp_path):
+    """An abandoned store (no close, so no tail seal) forfeits exactly
+    the unsealed segment: its flushed shard bytes are truncated on
+    resume and those targets recompute."""
+    d = str(tmp_path / "ck")
+    store = ckpt.CheckpointStore.create(d, "fp1", segment_targets=2)
+    store.commit(0, b"r0", b"AAAA")
+    store.commit(1, b"r1", b"CCCC")         # seals [0, 2)
+    store.commit(2, b"r2", b"GGGG")         # unsealed; flushed to shard
+    sealed_end = store.committed[1]["offset"] + \
+        store.committed[1]["length"]
+    assert os.path.getsize(store.shard_path) > sealed_end
+    # No close(): simulate eviction mid-segment.
+    res = ckpt.CheckpointStore.resume(d, "fp1")
+    assert sorted(res.committed) == [0, 1]
+    assert os.path.getsize(res.shard_path) == sealed_end
+    res.commit(2, b"r2", b"GGGG")           # recompute works
+    res.close()
+    fin = ckpt.CheckpointStore.resume(d, "fp1")
+    assert fin.read_emitted(2) == b">r2\nGGGG\n"
+    fin.close()
+
+
+def test_v2_torn_seal_fault_at_segment_boundary(tmp_path, soft_crash):
+    """The ckpt/manifest torn drill on a v2 store lands exactly on a
+    segment seal: half the segment record becomes durable, recovery
+    drops it and truncates the shard back to the last sealed segment."""
+    faults.configure("ckpt/manifest:1!torn")
+    d = str(tmp_path / "ck")
+    store = ckpt.CheckpointStore.create(d, "fp1", segment_targets=2)
+    store.commit(0, b"r0", b"AAAA")
+    store.commit(1, b"r1", b"CCCC")         # seal #1 (fault index 0)
+    store.commit(2, b"r2", b"GGGG")
+    with pytest.raises(soft_crash):
+        store.commit(3, b"r3", b"TTTT")     # seal #2 tears and dies
+    raw = open(store.manifest_path, "rb").read()
+    assert not raw.endswith(b"\n")          # genuinely torn tail
+    faults.configure(None)
+    res = ckpt.CheckpointStore.resume(d, "fp1")
+    assert sorted(res.committed) == [0, 1]
+    assert os.path.getsize(res.shard_path) == len(b">r0\nAAAA\n"
+                                                  b">r1\nCCCC\n")
+    clean = open(res.manifest_path, "rb").read()
+    assert clean.endswith(b"\n") and clean.count(b"\n") == 2
+    res.commit(2, b"r2", b"GGGG")
+    res.commit(3, b"r3", b"TTTT")
+    res.close()
+    fin = ckpt.CheckpointStore.resume(d, "fp1")
+    assert sorted(fin.committed) == [0, 1, 2, 3]
+    assert fin.read_emitted(3) == b">r3\nTTTT\n"
+    fin.close()
+
+
+def test_v2_compaction_byte_identity(tmp_path, monkeypatch):
+    """Compaction merges adjacent contiguous segments and atomically
+    rewrites the manifest; recovery from the compacted store must be
+    byte-identical to its uncompacted twin."""
+    def fill(d, compact):
+        monkeypatch.setenv(ckpt.ENV_AVA_COMPACT, compact)
+        with ckpt.CheckpointStore.create(d, "fp1",
+                                         segment_targets=2) as store:
+            for tid in range(8):
+                store.commit(tid, b"r%d" % tid, b"ACGT" * (tid + 1))
+
+    a = str(tmp_path / "compacted")
+    b = str(tmp_path / "plain")
+    fill(a, "2")        # compact every 2 seals
+    fill(b, "0")        # never compact
+    monkeypatch.delenv(ckpt.ENV_AVA_COMPACT)
+
+    n_lines = lambda d: open(os.path.join(d, ckpt.MANIFEST_NAME),
+                             "rb").read().count(b"\n")
+    assert n_lines(b) == 5                  # header + 4 seg records
+    assert n_lines(a) < n_lines(b)
+
+    ra = ckpt.CheckpointStore.resume(a, "fp1")
+    rb = ckpt.CheckpointStore.resume(b, "fp1")
+    assert sorted(ra.committed) == sorted(rb.committed) == list(range(8))
+    for tid in range(8):
+        assert ra.read_emitted(tid) == rb.read_emitted(tid)
+    ra.close()
+    rb.close()
+    snap = obs_metrics.registry().snapshot()
+    assert snap["res_ckpt_compactions"] >= 1
+
+
+def test_v1_stores_unaffected_by_v2_code(tmp_path):
+    """segment_targets=0 (the kC default) writes a byte-for-byte v1
+    manifest: per-target records carrying names, no header mode flag."""
+    d = str(tmp_path / "ck")
+    with ckpt.CheckpointStore.create(d, "fp1",
+                                     segment_targets=0) as store:
+        store.commit(0, b"c0", b"ACGT")
+    recs = [json.loads(x) for x in
+            open(os.path.join(d, ckpt.MANIFEST_NAME),
+                 "rb").read().splitlines()]
+    assert "manifest" not in recs[0]
+    assert recs[1]["name"] == "c0"
+    res = ckpt.CheckpointStore.resume(d, "fp1")
+    assert res.segment_targets == 0
+    assert res.read_emitted(0) == b">c0\nACGT\n"
+    res.close()
+
+
 # ------------------------------------------- degradation + CLI integration
 
 
